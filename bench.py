@@ -1,0 +1,93 @@
+"""Benchmark: simulated gossip throughput on the current backend.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Headline metric: node-ticks/second of the dense full-view membership
+simulation at N=512 (the BASELINE.json intermediate config
+"multifailure, N=512"), whole run resident on device via lax.scan.
+
+Baseline: the reference's measured throughput is 3,500-14,000 ticks/s at
+N=10 on one CPU core (BASELINE.md) = at best ~1.4e5 node-ticks/s; we use
+the best-case 1.4e5 * (10 nodes) => 1.4e6... more precisely BASELINE.md
+reports ~0.35-1.4 M node-ticks/s; vs_baseline divides by the top of that
+range (1.4e6 node-ticks/s), so vs_baseline > 1 means faster than the
+reference has ever measured, on a strictly harder (51x larger) config.
+"""
+
+import json
+import multiprocessing
+import os
+import sys
+import time
+
+REFERENCE_NODE_TICKS_PER_S = 1.4e6  # BASELINE.md best case, N=10, 1 CPU core
+
+
+def _probe_backend(q):
+    try:
+        import jax
+        q.put(jax.default_backend())
+    except Exception:
+        q.put("error")
+
+
+def _backend_or_cpu(timeout_s: float = 180.0) -> str:
+    """Bounded accelerator probe.
+
+    This image routes the TPU through a single-grant tunnel that can
+    block ``jax.devices()`` indefinitely if a previous client died
+    mid-claim; a hung bench is worse than a CPU number, so probe the
+    backend in a subprocess with a deadline and fall back to CPU.
+    """
+    ctx = multiprocessing.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_probe_backend, args=(q,))
+    p.start()
+    p.join(timeout_s)
+    if p.is_alive():
+        p.kill()
+        p.join()
+        return "cpu"
+    try:
+        backend = q.get_nowait()
+    except Exception:
+        backend = "cpu"
+    return backend if backend not in ("error",) else "cpu"
+
+
+def main():
+    smoke = "--smoke" in sys.argv
+    n = 64 if smoke else 512
+    ticks = 100 if smoke else 700
+
+    backend = _backend_or_cpu(60.0 if smoke else 180.0)
+    if backend == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from gossip_protocol_tpu.config import SimConfig
+    from gossip_protocol_tpu.core.sim import Simulation
+
+    cfg = SimConfig(max_nnb=n, single_failure=False, drop_msg=True,
+                    msg_drop_prob=0.1, seed=0, total_ticks=ticks)
+    sim = Simulation(cfg)
+    res = sim.run_bench()          # compiles on the warmup run, times the second
+    best = res
+    for _ in range(2):             # take the best of 3 timed runs
+        r = sim.run_bench(warmup=False)
+        if r.wall_seconds < best.wall_seconds:
+            best = r
+
+    value = best.node_ticks_per_second
+    print(json.dumps({
+        "metric": f"node_ticks_per_s_n{n}_fullview",
+        "value": round(value, 1),
+        "unit": "node-ticks/s",
+        "vs_baseline": round(value / REFERENCE_NODE_TICKS_PER_S, 3),
+        "backend": backend,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""gossip_protocol_tpu — a TPU-native gossip membership-protocol framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of the C++ reference
+``Bobbyyang1314/Gossip_Protocol`` (the classic MP1 membership protocol:
+introducer-based join, all-pairs heartbeat gossip, TREMOVE staleness
+failure detection, scripted fault/drop injection, grep-able dbg.log).
+
+Instead of stepping N node objects over an in-memory message buffer, the
+entire world is a handful of device arrays and one tick is one jitted
+pure function (see ``core/tick.py``); a full run is a ``lax.scan``.  The
+reference's .conf format, CLI shape, and log grammars are preserved so
+its grading harness passes unmodified; peer count scales far past the
+reference's hard N<=10 cap via sharding (``parallel/``) and bounded
+partial-view overlays (``models/overlay.py``).
+"""
+
+from .config import (INTRODUCER, MSG_DROP_SINGLE_FAILURE, MULTI_FAILURE,
+                     SINGLE_FAILURE, SimConfig)
+from .state import Schedule, WorldState, init_state, make_schedule
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "SimConfig", "SimPreset", "INTRODUCER",
+    "SINGLE_FAILURE", "MULTI_FAILURE", "MSG_DROP_SINGLE_FAILURE",
+    "WorldState", "Schedule", "init_state", "make_schedule",
+    "Simulation", "run_scenario",
+]
+
+
+def __getattr__(name):
+    # lazy imports so `import gossip_protocol_tpu` stays light
+    if name in ("Simulation", "run_scenario"):
+        from .core import sim
+        return getattr(sim, name)
+    raise AttributeError(name)

@@ -1,0 +1,90 @@
+"""Command-line entry point, CLI-compatible with the reference binary.
+
+The reference is invoked as ``./Application <testcase.conf>``
+(Application.cpp:27-42) and writes dbg.log / stats.log / msgcount.log
+into the working directory.  This module does the same:
+
+    python -m gossip_protocol_tpu testcases/singlefailure.conf
+
+plus framework extras (--seed, --outdir, -n to scale the peer count,
+--bench).  The standalone C++ launcher ``native/gossip_app.cc`` embeds
+the interpreter and calls :func:`main`, giving a drop-in
+``./Application`` binary for harnesses that exec a native executable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .addressing import display_addr
+from .config import SimConfig
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="gossip_protocol_tpu",
+        description="TPU-native gossip membership-protocol simulator")
+    ap.add_argument("conf", help="testcase .conf file (reference format)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="PRNG seed (default: from config; reference uses "
+                         "wall-clock seeding, pass --seed -1 to mimic)")
+    ap.add_argument("-n", "--peers", type=int, default=None,
+                    help="override MAX_NNB (scale the scenario)")
+    ap.add_argument("--ticks", type=int, default=None,
+                    help="override TOTAL_RUNNING_TIME (default 700)")
+    ap.add_argument("--outdir", default=".",
+                    help="directory for dbg.log/stats.log/msgcount.log")
+    ap.add_argument("--bench", action="store_true",
+                    help="benchmark mode: no logs, print one JSON line")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress the per-node introduction stdout lines")
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu, tpu); default: "
+                         "jax's own selection")
+    args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+
+    overrides = {}
+    if args.seed is not None:
+        overrides["seed"] = args.seed if args.seed >= 0 else None
+        if overrides["seed"] is None:
+            import time as _t
+            overrides["seed"] = int(_t.time())
+    if args.peers is not None:
+        overrides["max_nnb"] = args.peers
+    if args.ticks is not None:
+        overrides["total_ticks"] = args.ticks
+    cfg = SimConfig.from_conf(args.conf, **overrides)
+
+    from .core.sim import Simulation
+
+    sim = Simulation(cfg)
+    if args.bench:
+        res = sim.run_bench()
+        print(json.dumps({
+            "n": cfg.n, "ticks": cfg.total_ticks,
+            "wall_s": round(res.wall_seconds, 6),
+            "ticks_per_s": round(res.ticks_per_second, 1),
+            "node_ticks_per_s": round(res.node_ticks_per_second, 1),
+        }))
+        return 0
+
+    if not args.quiet:
+        # parity with the driver's stdout (Application.cpp:146) — the
+        # reference prints these as each node is introduced
+        for i in range(cfg.n):
+            print(f"{i}-th introduced node is assigned with the address: "
+                  f"{display_addr(i)}")
+
+    res = sim.run()
+    res.write_logs(args.outdir)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,120 @@
+"""Configuration system.
+
+TPU-native replacement for the reference's ``Params`` class
+(reference: Params.h:21-36, Params.cpp:19-50).  The reference reads a
+4-line positional ``.conf`` file (Params.cpp:22-25) and derives everything
+else from compile-time constants (Application.h:27 TOTAL_RUNNING_TIME=700,
+MP1Node.h:21-22 TREMOVE=20/TFAIL=5, EmulNet.h:10-12 buffer limits,
+Params.cpp:29-31 STEP_RATE/MAX_MSG_SIZE/PORTNUM).
+
+Here everything is one frozen dataclass.  The legacy ``.conf`` grammar is
+still ingested by :func:`SimConfig.from_conf` so the reference's
+``testcases/*.conf`` files work unmodified, and extended knobs (seed,
+peer count overrides, topology family, churn) are first-class fields
+instead of hardcoded constants.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from dataclasses import dataclass
+from typing import Optional
+
+#: Index (0-based) of the introducer/coordinator peer.  The reference
+#: hardwires the join address to id=1:port=0 (Application.cpp:209-217,
+#: MP1Node.cpp:378-386); ids are assigned sequentially from 1
+#: (EmulNet.cpp:72-77), so the introducer is always peer index 0.
+INTRODUCER = 0
+
+
+@dataclass(frozen=True)
+class SimConfig:
+    """All parameters of one simulation scenario.
+
+    Field names follow the reference's .conf keys where they exist
+    (Params.cpp:22-25); the rest mirror the reference's compile-time
+    constants with the same defaults.
+    """
+
+    # --- legacy .conf fields (Params.cpp:22-25) ---
+    max_nnb: int = 10            # MAX_NNB -> number of peers (EN_GPSZ = MAX_NNB, Params.cpp:29)
+    single_failure: bool = True  # SINGLE_FAILURE
+    drop_msg: bool = False       # DROP_MSG
+    msg_drop_prob: float = 0.1   # MSG_DROP_PROB
+
+    # --- reference compile-time constants ---
+    total_ticks: int = 700       # TOTAL_RUNNING_TIME (Application.h:27)
+    step_rate: float = 0.25      # Params.cpp:30; node i starts at int(step_rate*i)
+    t_remove: int = 20           # TREMOVE (MP1Node.h:21)
+    t_fail: int = 5              # TFAIL (MP1Node.h:22) — vestigial in the reference too
+    portnum: int = 8001          # Params.cpp:12 — note ENinit still assigns port 0
+    max_msg_size: int = 4000     # Params.cpp:31
+    en_buff_size: int = 30000    # ENBUFFSIZE (EmulNet.h:12)
+    fail_tick: int = 100         # failure injection time (Application.cpp:181,188)
+    drop_open_tick: int = 50     # drop window opens (Application.cpp:177)
+    drop_close_tick: int = 300   # drop window closes (Application.cpp:198)
+
+    # --- new framework knobs (absent in the reference) ---
+    #: PRNG seed.  The reference uses ``srand(time(NULL))`` twice
+    #: (Application.cpp:50,96) so its runs are irreproducible; we default
+    #: to a fixed seed and treat reproducibility as a feature.
+    seed: int = 0
+    #: Protocol/model family: "full_view" reproduces the reference's
+    #: all-pairs full-list heartbeating; "overlay" is the bounded
+    #: partial-view family for very large N (BASELINE.json 65k/1M configs).
+    model: str = "full_view"
+    #: Overlay fanout (only used by model="overlay"); 0 = auto (~log2 N).
+    fanout: int = 0
+    #: Churn rate per tick (overlay extension; 0 disables).
+    churn_rate: float = 0.0
+
+    @property
+    def n(self) -> int:
+        """Number of peers (the reference's EN_GPSZ, Params.cpp:29)."""
+        return self.max_nnb
+
+    def start_tick(self, i: int) -> int:
+        """Tick at which peer index ``i`` is introduced.
+
+        Reference: nodes start when ``t == (int)(STEP_RATE*i)``
+        (Application.cpp:143), i.e. C truncation of 0.25*i.
+        """
+        return int(self.step_rate * i)
+
+    def replace(self, **kw) -> "SimConfig":
+        return dataclasses.replace(self, **kw)
+
+    # --- legacy .conf ingestion -------------------------------------
+    @classmethod
+    def from_conf(cls, path: str, **overrides) -> "SimConfig":
+        """Parse a reference-format .conf file (Params.cpp:22-25).
+
+        The reference reads exactly four ``KEY: value`` lines in fixed
+        order with fscanf; we accept them in any order and ignore
+        unknown keys, but the three shipped testcases parse bit-identically.
+        """
+        keys = {}
+        with open(path, "r") as f:
+            for line in f:
+                m = re.match(r"\s*([A-Z_]+)\s*:\s*([0-9.eE+-]+)", line)
+                if m:
+                    keys[m.group(1)] = m.group(2)
+        kw = {}
+        if "MAX_NNB" in keys:
+            kw["max_nnb"] = int(keys["MAX_NNB"])
+        if "SINGLE_FAILURE" in keys:
+            kw["single_failure"] = bool(int(keys["SINGLE_FAILURE"]))
+        if "DROP_MSG" in keys:
+            kw["drop_msg"] = bool(int(keys["DROP_MSG"]))
+        if "MSG_DROP_PROB" in keys:
+            kw["msg_drop_prob"] = float(keys["MSG_DROP_PROB"])
+        kw.update(overrides)
+        return cls(**kw)
+
+
+#: The three scenarios shipped with the reference (testcases/*.conf).
+SINGLE_FAILURE = SimConfig(max_nnb=10, single_failure=True, drop_msg=False)
+MULTI_FAILURE = SimConfig(max_nnb=10, single_failure=False, drop_msg=False)
+MSG_DROP_SINGLE_FAILURE = SimConfig(max_nnb=10, single_failure=True, drop_msg=True,
+                                    msg_drop_prob=0.1)

@@ -1,0 +1,155 @@
+"""Simulation orchestrator.
+
+TPU-native replacement for the reference ``Application`` driver
+(Application.cpp:90-163): instead of a host loop that steps N C++
+objects, the whole run is one (or a few, when chunked) ``lax.scan`` XLA
+programs over the tick function, with event masks streamed back to host
+only as often as the caller needs them.
+
+Modes:
+* trace mode (``run()``)  — stacked per-tick event masks come back to
+  host; feeds the dbg.log writer and the grader checks.  Chunked over
+  ticks so event staging memory stays bounded at large N.
+* bench mode (``run_bench()``) — no event masks, counters only; the
+  entire 700-tick run stays on device and is timed end-to-end.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..config import SimConfig
+from ..events import LogEvent, event_stream, grader_view
+from ..state import Schedule, WorldState, init_state, make_schedule
+from .tick import make_run, make_tick
+
+
+@dataclass
+class SimResult:
+    """Host-side digest of a finished run."""
+
+    cfg: SimConfig
+    start_tick: np.ndarray   # i32[N]
+    fail_tick: np.ndarray    # i32[N]
+    added: Optional[np.ndarray]    # bool[T, N, N] (trace mode only)
+    removed: Optional[np.ndarray]  # bool[T, N, N]
+    sent: np.ndarray         # i32[N, T]
+    recv: np.ndarray         # i32[N, T]
+    final_state: WorldState
+    wall_seconds: float
+
+    def events(self) -> list[LogEvent]:
+        assert self.added is not None, "events need a trace-mode run"
+        return list(event_stream(self.cfg, self.start_tick, self.fail_tick,
+                                 self.added, self.removed))
+
+    def grader_view(self) -> dict:
+        return grader_view(self.events())
+
+    def write_logs(self, outdir: str = ".") -> None:
+        from ..logging_compat import write_dbg_log, write_msgcount_log
+        write_dbg_log(self.events(), outdir)
+        write_msgcount_log(self.sent, self.recv, outdir)
+
+    # --- convenience metrics ---------------------------------------
+    @property
+    def ticks_per_second(self) -> float:
+        return self.cfg.total_ticks / self.wall_seconds
+
+    @property
+    def node_ticks_per_second(self) -> float:
+        return self.ticks_per_second * self.cfg.n
+
+
+class Simulation:
+    """Compile once per (config shape), run many times."""
+
+    def __init__(self, cfg: SimConfig, block_size: int = 128,
+                 chunk_ticks: Optional[int] = None):
+        self.cfg = cfg
+        self.block_size = block_size
+        # Default chunking keeps staged event masks under ~256 MB.
+        if chunk_ticks is None:
+            per_tick = 2 * cfg.n * cfg.n  # two bool masks
+            chunk_ticks = max(1, min(cfg.total_ticks, (256 << 20) // max(per_tick, 1)))
+        self.chunk_ticks = chunk_ticks
+        self._trace_runs = {}
+        self._bench_run = None
+
+    def _trace_run_fn(self, length: int):
+        if length not in self._trace_runs:
+            cfg = self.cfg.replace(total_ticks=length)
+            self._trace_runs[length] = make_run(cfg, self.block_size,
+                                                with_events=True)
+        return self._trace_runs[length]
+
+    def run(self, seed: Optional[int] = None) -> SimResult:
+        """Trace-mode run: full event masks for logging/grading."""
+        cfg = self.cfg if seed is None else self.cfg.replace(seed=seed)
+        sched = make_schedule(cfg)
+        state = init_state(cfg)
+        t_total = cfg.total_ticks
+        added, removed, sent, recv = [], [], [], []
+        t0 = time.perf_counter()
+        done = 0
+        while done < t_total:
+            length = min(self.chunk_ticks, t_total - done)
+            run = self._trace_run_fn(length)
+            state, ev = run(state, sched)
+            added.append(np.asarray(ev.added))
+            removed.append(np.asarray(ev.removed))
+            sent.append(np.asarray(ev.sent))
+            recv.append(np.asarray(ev.recv))
+            done += length
+        wall = time.perf_counter() - t0
+        return SimResult(
+            cfg=cfg,
+            start_tick=np.asarray(sched.start_tick),
+            fail_tick=np.asarray(sched.fail_tick),
+            added=np.concatenate(added, 0),
+            removed=np.concatenate(removed, 0),
+            sent=np.concatenate(sent, 0).T.copy(),
+            recv=np.concatenate(recv, 0).T.copy(),
+            final_state=state,
+            wall_seconds=wall,
+        )
+
+    def run_bench(self, seed: Optional[int] = None, warmup: bool = True) -> SimResult:
+        """Bench-mode run: whole simulation on device, timed end-to-end."""
+        cfg = self.cfg if seed is None else self.cfg.replace(seed=seed)
+        sched = make_schedule(cfg)
+        if self._bench_run is None:
+            self._bench_run = make_run(cfg, self.block_size, with_events=False)
+        run = self._bench_run
+        if warmup:  # compile outside the timed region
+            s, e = run(init_state(cfg), sched)
+            jax.block_until_ready(s)
+        state = init_state(cfg)
+        t0 = time.perf_counter()
+        state, ev = run(state, sched)
+        jax.block_until_ready(state)
+        wall = time.perf_counter() - t0
+        return SimResult(
+            cfg=cfg,
+            start_tick=np.asarray(sched.start_tick),
+            fail_tick=np.asarray(sched.fail_tick),
+            added=None, removed=None,
+            sent=np.asarray(ev.sent).T.copy(),
+            recv=np.asarray(ev.recv).T.copy(),
+            final_state=state,
+            wall_seconds=wall,
+        )
+
+
+def run_scenario(cfg: SimConfig, outdir: Optional[str] = None,
+                 **sim_kw) -> SimResult:
+    """One-call helper: simulate and (optionally) write the three logs."""
+    result = Simulation(cfg, **sim_kw).run()
+    if outdir is not None:
+        result.write_logs(outdir)
+    return result

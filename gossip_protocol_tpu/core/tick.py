@@ -1,0 +1,275 @@
+"""One simulation tick as a single pure function.
+
+The reference's driver runs, per global time step (Application.cpp:99-163):
+
+  phase A — every started, live node drains its network inbox
+            (``recvLoop``, Application.cpp:125-135);
+  phase B — in reverse node order, nodes are introduced
+            (``nodeStart``) or run ``nodeLoop`` = process queued
+            messages, then periodic ops (Application.cpp:138-163);
+  then    — scripted fault injection (``fail``, Application.cpp:173-202).
+
+Because every message sent during tick *t* sits in the EmulNet buffer
+until the receivers' phase A of tick *t+1* (all sends happen in phase B,
+all receives in phase A), **no node observes another node's tick-t
+actions within tick t** — the reference's sequential reverse-order loop
+is only a logging order, not a data dependency.  The whole tick is
+therefore expressible as batched, order-free tensor algebra over the
+peer axis, which is what this module does.  One divergence is accepted
+and documented: within a single receiver's tick, the reference processes
+queued messages in EmulNet buffer order; we apply a canonical order
+(all piggyback merges, then all direct-sender updates, then join
+messages — matching the observed queue order gossip-before-JOINREP /
+gossip-before-JOINREQ, EmulNet.cpp:151-160).  The only reachable
+difference is a transient +/-1 on a heartbeat counter during the join
+phase, which is not observable in any logged event or removal time
+(asserted by tests/test_parity.py against the message-level oracle).
+
+Fault injection runs *after* the protocol phases (Application.cpp:99-104),
+so a node failed "at tick 100" still gossips during tick 100 and its
+flag is observed from tick 101 on — that, plus the one-tick delivery
+delay, is why the measured removal lands at fail + TREMOVE + 1 = t=121
+(BASELINE.md).
+
+The body is written once against the ``Comm`` interface
+(parallel/comm.py): with :class:`LocalComm` it is a single-device XLA
+program; inside ``shard_map`` with :class:`RingComm` the same code runs
+with the peer axis sharded across a device mesh — (N,) vectors
+replicated, (N, N) tables row-sharded, one ``all_to_all`` delivery
+transpose and a ``ppermute`` ring merge per tick.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import INTRODUCER, SimConfig
+from ..ops.detect import staleness_mask
+from ..parallel.comm import LocalComm
+from ..state import Schedule, WorldState
+
+
+@struct.dataclass
+class TickEvents:
+    """Grader-visible events produced by one tick, as dense masks.
+
+    The dbg.log writer (events.py) turns these into the reference's
+    exact log grammar; Grader.sh-style checks consume only these.
+    """
+
+    added: jax.Array    # bool[rows, N] — observer i added subject j this tick
+                        #   (logNodeAdd, Log.cpp:116-120)
+    removed: jax.Array  # bool[rows, N] — observer i removed subject j
+                        #   (logNodeRemove, Log.cpp:127-131)
+    sent: jax.Array     # i32[rows] — successful sends this tick (EmulNet.cpp:111)
+    recv: jax.Array     # i32[rows] — messages consumed this tick (EmulNet.cpp:172)
+
+
+def _row_keyed_uniform(key: jax.Array, row_ids: jax.Array, n: int) -> jax.Array:
+    """Per-row PRNG: row s draws its own (N,) uniforms from
+    ``fold_in(key, s)``.  Keyed by *global* row id so the single-device
+    and sharded paths produce bit-identical drop patterns."""
+    return jax.vmap(
+        lambda r: jax.random.uniform(jax.random.fold_in(key, r), (n,))
+    )(row_ids)
+
+
+def make_tick(cfg: SimConfig, block_size: int = 128, comm=None):
+    """Build the tick function for a config (shapes are static).
+
+    Returned signature: ``tick(state, sched) -> (state', TickEvents)``.
+    With a :class:`RingComm`, call it inside ``shard_map`` with (N, N)
+    arrays sharded ``P(axis, None)`` and everything else replicated.
+    """
+    comm = comm or LocalComm()
+    n = cfg.n
+    t_remove = cfg.t_remove
+    assert n % comm.n_shards == 0, "peer count must divide the mesh axis"
+
+    def tick(state: WorldState, sched: Schedule):
+        t = state.tick
+        row_ids = comm.row_ids(n)                        # global ids of local rows
+        col_ids = jnp.arange(n, dtype=jnp.int32)
+        self_mask = row_ids[:, None] == col_ids[None, :]  # local rows' diag
+        is_intro_row = row_ids == INTRODUCER
+        intro_onehot = col_ids == INTRODUCER
+
+        failed = sched.failed_at(t)
+        # recvLoop/nodeLoop gate: strictly after the start tick and not
+        # failed (Application.cpp:130,153).
+        proc = (t > sched.start_tick) & ~failed
+
+        # ---- phase A: consume in-flight traffic --------------------
+        deliver = state.gossip & proc[None, :]           # [rows=s, r] consumed now
+        jreq = state.joinreq & proc[INTRODUCER]          # requests the introducer processes
+        jrep = state.joinrep & proc                      # JOINREPs joiners process
+        recv_from = comm.transpose(deliver)              # [rows=r, s]
+
+        # ---- checkMessages: GOSSIP piggyback merge -----------------
+        # (MP1Node.cpp:244-256; add path MP1Node.cpp:282-301)
+        m_hb_all, m_hb_fresh, m_ts_fresh, any_fresh = comm.merge_reduce(
+            recv_from, state.known, state.hb, state.ts, t,
+            t_remove=t_remove, block_size=block_size)
+
+        exists = state.known
+        # merge into existing entries: adopt a strictly larger heartbeat
+        # and refresh the timestamp (MP1Node.cpp:248-251)
+        inc = exists & (m_hb_all > state.hb)
+        hb = jnp.where(inc, m_hb_all, state.hb)
+        ts = jnp.where(inc, t, state.ts)
+        # add unknown entries if some contribution is fresh
+        # (freshness gate at receive time, MP1Node.cpp:294); never self
+        # (MP1Node.cpp:290-293).  The entry value mirrors "copy the
+        # fresh entry, then later messages may merge it up, stamping
+        # the local clock" under the canonical order.
+        padd = ~exists & any_fresh & ~self_mask
+        hb = jnp.where(padd, m_hb_all, hb)
+        ts = jnp.where(padd, jnp.where(m_hb_all > m_hb_fresh, t, m_ts_fresh), ts)
+
+        # ---- checkMessages: GOSSIP direct-sender handling ----------
+        # A known sender's heartbeat is *incremented* locally (not
+        # adopted) and its timestamp refreshed; an unknown sender is
+        # added with heartbeat 1 (MP1Node.cpp:236-242, 265-280).
+        known_pb = exists | padd
+        dinc = recv_from & known_pb
+        hb = jnp.where(dinc, hb + 1, hb)
+        ts = jnp.where(dinc, t, ts)
+        dadd = recv_from & ~known_pb & ~self_mask
+        hb = jnp.where(dadd, 1, hb)
+        ts = jnp.where(dadd, t, ts)
+        known = exists | padd | dadd
+
+        # ---- checkMessages: JOINREQ at the introducer --------------
+        # add the requester (dedup'd) and send back a JOINREP
+        # (MP1Node.cpp:221-230)
+        intro_row = comm.or_across(jnp.any(known & is_intro_row[:, None], 0))
+        qadd = jreq & ~intro_row & ~intro_onehot         # [N], replicated
+        q_cell = is_intro_row[:, None] & qadd[None, :]   # local cells to write
+        known = known | q_cell
+        hb = jnp.where(q_cell, 1, hb)
+        ts = jnp.where(q_cell, t, ts)
+        rep_out = jreq
+
+        # ---- checkMessages: JOINREP at the joiner ------------------
+        # add the introducer (dedup'd — usually already added via its
+        # gossip, processed earlier in queue order) and enter the group
+        # (MP1Node.cpp:231-233)
+        radd_rows = jrep[row_ids] & ~known[:, INTRODUCER]
+        r_cell = radd_rows[:, None] & intro_onehot[None, :]
+        known = known | r_cell
+        hb = jnp.where(r_cell, 1, hb)
+        ts = jnp.where(r_cell, t, ts)
+        in_group = state.in_group | jrep
+
+        known_after_adds = known
+
+        # ---- nodeStart: staggered introduction ---------------------
+        # (Application.cpp:143-148; MP1Node.cpp:120-154)
+        starting = (t == sched.start_tick) & ~failed
+        in_group = in_group | (starting & intro_onehot)  # "Starting up group..."
+        joinreq_new = starting & ~intro_onehot           # JOINREQ send
+
+        # ---- nodeLoopOps: heartbeat, detection, dissemination ------
+        # only started, live, in-group nodes (MP1Node.cpp:185-190);
+        # in_group may have been set this very tick (JOINREP processed
+        # in checkMessages before the in-group test, MP1Node.cpp:182-190)
+        ops = proc & in_group
+        own_hb = state.own_hb + ops.astype(jnp.int32)    # MP1Node.cpp:337
+        ops_rows = ops[row_ids]
+
+        stale = staleness_mask(ops_rows, known, ts, t, t_remove)
+        known = known & ~stale
+
+        # full-list gossip to every remaining member (MP1Node.cpp:350-361)
+        send = ops_rows[:, None] & known
+
+        # ---- ENsend drop injection (EmulNet.cpp:90-94) -------------
+        key = jax.random.fold_in(state.rng, t)
+        kg, kq, kp = jax.random.split(key, 3)
+        active = sched.drop_active[t]
+        p_drop = sched.drop_prob
+        gdrop = active & (_row_keyed_uniform(kg, row_ids, n) < p_drop)
+        qdrop = active & (jax.random.uniform(kq, (n,)) < p_drop)
+        pdrop = active & (jax.random.uniform(kp, (n,)) < p_drop)
+        gossip_sent = send & ~gdrop
+        joinreq_sent = joinreq_new & ~qdrop
+        joinrep_sent = rep_out & ~pdrop
+
+        # unconsumed traffic stays in flight (the EmulNet buffer holds
+        # messages until the receiver's next recvLoop) — except traffic
+        # to failed receivers, which in the reference rots in the buffer
+        # forever (failed nodes never call recvLoop again,
+        # Application.cpp:130, MP1Node.cpp:42-44) and is dropped here.
+        live_hold = ~proc & ~failed
+        gossip_next = gossip_sent | (state.gossip & live_hold[None, :])
+        joinreq_next = joinreq_sent | (state.joinreq
+                                       & ~proc[INTRODUCER] & ~failed[INTRODUCER])
+        joinrep_next = joinrep_sent | (state.joinrep & live_hold)
+
+        # ---- accounting (EmulNet.cpp:111,172) ----------------------
+        # row-local (each device counts for its own peers; logically [N])
+        rep_total = joinrep_sent.sum().astype(jnp.int32)
+        req_total = jreq.sum().astype(jnp.int32)
+        sent = gossip_sent.sum(1).astype(jnp.int32) \
+            + joinreq_sent[row_ids].astype(jnp.int32) \
+            + jnp.where(is_intro_row, rep_total, 0)
+        recv = recv_from.sum(1).astype(jnp.int32) \
+            + jrep[row_ids].astype(jnp.int32) \
+            + jnp.where(is_intro_row, req_total, 0)
+
+        events = TickEvents(
+            added=known_after_adds & ~exists,
+            removed=stale,
+            sent=sent,
+            recv=recv,
+        )
+        new_state = WorldState(
+            tick=t + 1,
+            in_group=in_group,
+            own_hb=own_hb,
+            known=known,
+            hb=hb,
+            ts=ts,
+            gossip=gossip_next,
+            joinreq=joinreq_next,
+            joinrep=joinrep_next,
+            rng=state.rng,
+        )
+        return new_state, events
+
+    return tick
+
+
+#: Compiled whole-run functions, shared across Simulation instances.
+#: Everything config-dependent that isn't in the cache key flows in
+#: through the Schedule arrays, so reuse is sound.
+_RUN_CACHE: dict = {}
+
+
+def make_run(cfg: SimConfig, block_size: int = 128, with_events: bool = True):
+    """Whole-run function: ``lax.scan`` of the tick over all T ticks.
+
+    Returns a jitted ``run(state, sched) -> (final_state, stacked_events)``.
+    With ``with_events=False`` only the send/recv counters are stacked
+    (benchmark mode — avoids materializing T*(N,N) masks).
+    """
+    key = (cfg.n, cfg.t_remove, cfg.total_ticks, block_size, with_events)
+    if key in _RUN_CACHE:
+        return _RUN_CACHE[key]
+    tick = make_tick(cfg, block_size)
+
+    @jax.jit
+    def run(state: WorldState, sched: Schedule):
+        def step(carry, _):
+            carry, ev = tick(carry, sched)
+            if not with_events:
+                ev = TickEvents(added=jnp.zeros((), bool),
+                                removed=jnp.zeros((), bool),
+                                sent=ev.sent, recv=ev.recv)
+            return carry, ev
+        return jax.lax.scan(step, state, None, length=cfg.total_ticks)
+
+    _RUN_CACHE[key] = run
+    return run

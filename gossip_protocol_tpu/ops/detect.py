@@ -1,0 +1,30 @@
+"""Failure detection as a vectorized staleness mask.
+
+Replaces the reference's per-node reverse scan over the member list
+(``nodeLoopOps``, MP1Node.cpp:339-348): every entry whose timestamp is
+``TREMOVE`` or more ticks old is removed and logged.  There is no
+suspect/TFAIL phase in the reference (``pingCounter``/``timeOutCounter``
+are initialized, MP1Node.cpp:108-109, but never read), so staleness goes
+straight to removal here too.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def staleness_mask(ops_mask, known, ts, now, t_remove):
+    """bool[N, N]: entries to remove this tick.
+
+    Args:
+      ops_mask: bool[N] — peers running their periodic ops this tick
+        (started, live, in-group; Application.cpp:153, MP1Node.cpp:185-190).
+      known:    bool[N, N] — current membership tables.
+      ts:       i32[N, N] — entry timestamps.
+      now:      i32 scalar — current logical time.
+      t_remove: TREMOVE horizon (MP1Node.h:21).
+
+    The comparison is ``now - ts >= t_remove`` exactly as in
+    MP1Node.cpp:340.
+    """
+    return ops_mask[:, None] & known & (now - ts >= t_remove)

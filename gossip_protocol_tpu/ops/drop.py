@@ -1,0 +1,28 @@
+"""Message-loss injection as a jit-able Bernoulli mask.
+
+Replaces ``EmulNet::ENsend``'s drop check (EmulNet.cpp:90-94):
+``rand() % 100 < MSG_DROP_PROB * 100`` while the ``dropmsg`` window is
+open.  The reference's ``srand(time(NULL))`` (Application.cpp:50,96)
+makes runs irreproducible; here the mask comes from a per-tick
+``jax.random`` key so every run is replayable from the config seed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def drop_mask(key: jax.Array, shape, active, prob) -> jax.Array:
+    """bool mask: True where a send is dropped.
+
+    Args:
+      key:    per-tick PRNG key (fold the tick index into the run key).
+      shape:  shape of the send lattice to mask.
+      active: bool scalar — is the drop window open for this tick's
+        sends?  (dropmsg is set after tick 50 and cleared after tick
+        300, Application.cpp:177-200, so sends during ticks [51, 300]
+        are droppable.)
+      prob:   f32 scalar drop probability (MSG_DROP_PROB).
+    """
+    return active & (jax.random.uniform(key, shape) < prob)

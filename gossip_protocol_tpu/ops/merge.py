@@ -1,0 +1,96 @@
+"""Gossip merge reductions.
+
+The reference merges incoming member lists one message at a time with
+linear scans (``recvCallBack`` GOSSIP branch, MP1Node.cpp:234-257:
+per-entry ``check_exist`` O(N) lookup + max-compare).  On TPU the whole
+receive+merge phase for *all* peers collapses into one masked max
+reduction over the sender axis — a (max, select) semiring "matmul":
+
+    M[r, j] = max over s of  hb[s, j]   where  recv_from[r, s] and known[s, j]
+
+Four reductions share the same pass (all-sources max, fresh-sources max,
+fresh-sources timestamp max, fresh-source existence); they are computed
+blockwise over the sender axis with ``lax.scan`` so peak memory stays
+O(R * B * J) instead of O(R * S * J).  A Pallas kernel with the same
+contract lives in ``ops/pallas/maxmerge.py`` for the hot path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+#: Fill value for "no contributing sender".  Real heartbeats are >= 1
+#: (entries are created with heartbeat 1, MP1Node.cpp:270) and real
+#: timestamps are >= 0, so -1 is unambiguous.
+FILL = jnp.int32(-1)
+
+
+@partial(jax.jit, static_argnames=("t_remove", "block_size"))
+def gossip_reductions(recv_from, known, hb, ts, now, *,
+                      t_remove: int, block_size: int = 128):
+    """Batched piggyback-merge statistics for every receiver at once.
+
+    Args:
+      recv_from: bool[R, S] — receiver r consumed a GOSSIP from sender s
+        this tick.
+      known:     bool[S, J] — sender s's member list contains j (the
+        payload membership, frozen at send time).
+      hb:        i32[S, J] — sender s's recorded heartbeat for j.
+      ts:        i32[S, J] — sender s's recorded timestamp for j.
+      now:       i32 scalar — current logical time (receive time).
+      t_remove:  the TREMOVE staleness horizon (MP1Node.h:21); an entry
+        is *fresh* iff ``now - ts < t_remove`` (the add gate,
+        MP1Node.cpp:294).
+      block_size: sender-axis block width for the scan.
+
+    Returns:
+      (m_hb_all, m_hb_fresh, m_ts_fresh, any_fresh), each [R, J]:
+        m_hb_all   — max heartbeat over all contributing senders (FILL
+                     if none).  Drives the merge-into-existing rule
+                     "adopt if strictly greater" (MP1Node.cpp:248-251).
+        m_hb_fresh — max heartbeat over *fresh* contributions only.
+        m_ts_fresh — max sender timestamp over fresh contributions.
+        any_fresh  — bool: some fresh contribution exists (the add gate).
+    """
+    r_dim, s_dim = recv_from.shape
+    j_dim = known.shape[1]
+    b = min(block_size, s_dim)
+    nb = -(-s_dim // b)
+    pad = nb * b - s_dim
+
+    if pad:
+        recv_from = jnp.pad(recv_from, ((0, 0), (0, pad)))
+        known = jnp.pad(known, ((0, pad), (0, 0)))
+        hb = jnp.pad(hb, ((0, pad), (0, 0)))
+        ts = jnp.pad(ts, ((0, pad), (0, 0)))
+
+    recv_blocks = recv_from.reshape(r_dim, nb, b).transpose(1, 0, 2)  # [nb, R, B]
+    known_blocks = known.reshape(nb, b, j_dim)
+    hb_blocks = hb.reshape(nb, b, j_dim)
+    ts_blocks = ts.reshape(nb, b, j_dim)
+
+    # Derive the accumulator initializers from the inputs (instead of
+    # plain constants) so that under shard_map they carry the same
+    # varying-axis type as the per-block contributions — a constant
+    # init would make the scan carry type-mismatch on a sharded mesh.
+    zero = recv_from[:, :1].astype(jnp.int32) * (hb[:1, :] * 0)
+    init = (zero + FILL, zero + FILL, zero + FILL, zero.astype(bool))
+
+    def body(carry, blk):
+        m_all, m_fr, t_fr, anyf = carry
+        d, kn, h, tsb = blk
+        contrib = d[:, :, None] & kn[None]                    # [R, B, J]
+        m_all = jnp.maximum(m_all, jnp.where(contrib, h[None], FILL).max(1))
+        fresh = contrib & (now - tsb[None] < t_remove)
+        m_fr = jnp.maximum(m_fr, jnp.where(fresh, h[None], FILL).max(1))
+        t_fr = jnp.maximum(t_fr, jnp.where(fresh, tsb[None], FILL).max(1))
+        anyf = anyf | fresh.any(1)
+        return (m_all, m_fr, t_fr, anyf), None
+
+    (m_all, m_fr, t_fr, anyf), _ = lax.scan(
+        body, init, (recv_blocks, known_blocks, hb_blocks, ts_blocks))
+    return m_all, m_fr, t_fr, anyf

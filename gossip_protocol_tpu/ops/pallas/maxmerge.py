@@ -1,0 +1,126 @@
+"""Pallas TPU kernel for the fused gossip-merge reduction.
+
+Computes the same four maxima as ``ops.merge.gossip_reductions`` — the
+(max, and) semiring "matmul" that replaces the reference's per-message
+linear-scan merge (MP1Node.cpp:236-256) — in one fused pass:
+
+    m_all[r, j]  = max_s { hb[s, j] : recv[r, s] & known[s, j] }
+    m_fr / t_fr  = ditto restricted to fresh entries (now - ts < TREMOVE)
+    anyf[r, j]   = fresh contribution exists
+
+Grid is (R/TR, J/TJ, S/TS) with the sender axis innermost; each program
+max-accumulates its (TR, TJ) output tile in VMEM across sender tiles,
+so the O(R*S*J) semiring contraction never round-trips HBM between
+sender blocks.  Inside a tile the sender axis is consumed in sublane
+chunks of 8 (the VPU's sublane width for 32-bit lanes), keeping the 3-D
+broadcast intermediate at (TR, 8, TJ).
+
+Masks travel as int32 0/1 (TPU-friendly tiling); the public wrapper
+accepts/returns the same dtypes as the XLA-path op.  ``interpret=True``
+is used automatically off-TPU so the kernel is testable on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..merge import FILL
+
+_SUB = 8  # sender sublane chunk
+
+
+def _kernel(t_remove: int, ts_tile: int,
+            now_ref, recv_ref, known_ref, hb_ref, ts_ref,
+            m_all_ref, m_fr_ref, t_fr_ref, anyf_ref):
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        m_all_ref[:] = jnp.full_like(m_all_ref, FILL)
+        m_fr_ref[:] = jnp.full_like(m_fr_ref, FILL)
+        t_fr_ref[:] = jnp.full_like(t_fr_ref, FILL)
+        anyf_ref[:] = jnp.zeros_like(anyf_ref)
+
+    now = now_ref[0]
+    recv = recv_ref[:]          # (TR, TS) int32 0/1
+    known = known_ref[:]        # (TS, TJ)
+    hb = hb_ref[:]
+    ts = ts_ref[:]
+    fresh_row = (now - ts < t_remove)  # (TS, TJ) bool
+
+    m_all = m_all_ref[:]
+    m_fr = m_fr_ref[:]
+    t_fr = t_fr_ref[:]
+    anyf = anyf_ref[:]
+
+    for s0 in range(0, ts_tile, _SUB):
+        d8 = recv[:, s0:s0 + _SUB] > 0                    # (TR, 8)
+        k8 = known[s0:s0 + _SUB] > 0                      # (8, TJ)
+        contrib = d8[:, :, None] & k8[None]               # (TR, 8, TJ)
+        hb8 = hb[s0:s0 + _SUB][None]
+        m_all = jnp.maximum(m_all, jnp.where(contrib, hb8, FILL).max(1))
+        fresh = contrib & fresh_row[s0:s0 + _SUB][None]
+        m_fr = jnp.maximum(m_fr, jnp.where(fresh, hb8, FILL).max(1))
+        t_fr = jnp.maximum(t_fr,
+                           jnp.where(fresh, ts[s0:s0 + _SUB][None], FILL).max(1))
+        anyf = anyf | fresh.any(1).astype(jnp.int32)
+
+    m_all_ref[:] = m_all
+    m_fr_ref[:] = m_fr
+    t_fr_ref[:] = t_fr
+    anyf_ref[:] = anyf
+
+
+@functools.partial(jax.jit, static_argnames=("t_remove", "tile_r", "tile_j",
+                                             "tile_s", "interpret"))
+def gossip_reductions_pallas(recv_from, known, hb, ts, now, *,
+                             t_remove: int, tile_r: int = 128,
+                             tile_j: int = 128, tile_s: int = 128,
+                             interpret: bool | None = None):
+    """Drop-in Pallas implementation of ``ops.merge.gossip_reductions``.
+
+    Shapes must tile evenly (pad at the call site if needed; the tick
+    path uses power-of-two N for the dense model).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    r_dim, s_dim = recv_from.shape
+    j_dim = known.shape[1]
+    tr = min(tile_r, r_dim)
+    tj = min(tile_j, j_dim)
+    tss = min(tile_s, s_dim)
+    assert r_dim % tr == 0 and j_dim % tj == 0 and s_dim % tss == 0 \
+        and tss % _SUB == 0, (r_dim, s_dim, j_dim, tr, tj, tss)
+
+    grid = (r_dim // tr, j_dim // tj, s_dim // tss)
+    out_shape = [jax.ShapeDtypeStruct((r_dim, j_dim), jnp.int32)] * 4
+    out_spec = pl.BlockSpec((tr, tj), lambda i, j, k: (i, j),
+                            memory_space=pltpu.VMEM)
+
+    m_all, m_fr, t_fr, anyf = pl.pallas_call(
+        functools.partial(_kernel, t_remove, tss),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),               # now
+            pl.BlockSpec((tr, tss), lambda i, j, k: (i, k),
+                         memory_space=pltpu.VMEM),               # recv_from
+            pl.BlockSpec((tss, tj), lambda i, j, k: (k, j),
+                         memory_space=pltpu.VMEM),               # known
+            pl.BlockSpec((tss, tj), lambda i, j, k: (k, j),
+                         memory_space=pltpu.VMEM),               # hb
+            pl.BlockSpec((tss, tj), lambda i, j, k: (k, j),
+                         memory_space=pltpu.VMEM),               # ts
+        ],
+        out_specs=[out_spec] * 4,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(jnp.asarray([now], jnp.int32),
+      recv_from.astype(jnp.int32), known.astype(jnp.int32),
+      hb.astype(jnp.int32), ts.astype(jnp.int32))
+
+    return m_all, m_fr, t_fr, anyf.astype(bool)

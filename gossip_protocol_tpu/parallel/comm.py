@@ -1,0 +1,128 @@
+"""Communication backends for the tick function.
+
+The reference's "distributed backend" is EmulNet — a single shared
+in-process buffer (EmulNet.h:35-72) scanned O(buffer) per node per tick
+(EmulNet.cpp:151-174).  Here the equivalent component is a small
+collective-communication abstraction over the peer-sharded state:
+
+* :class:`LocalComm`  — single device; transposes are array transposes
+  and reductions run in one pass.
+* :class:`RingComm`   — the peer axis (and with it every row of the
+  (N, N) membership tables) is sharded across a ``jax.sharding.Mesh``
+  axis inside ``shard_map``.  Delivery consumption becomes one
+  ``all_to_all`` (the matrix transpose from sender-major to
+  receiver-major), and the gossip merge becomes a **ring reduction**:
+  payload row-blocks rotate around the mesh axis with ``ppermute``
+  while each device max-accumulates into its local receiver rows —
+  the same blockwise pattern ring attention uses for long sequences,
+  applied to the peer axis (SURVEY.md §2.3).  Collectives ride ICI
+  inside a slice / DCN across slices; nothing here assumes either.
+
+The tick body is written once against this interface; sharding is a
+deployment choice, not a code path fork.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.merge import FILL, gossip_reductions
+
+
+class LocalComm:
+    """Single-device (or fully-replicated) execution."""
+
+    n_shards = 1
+
+    def row_ids(self, n: int) -> jax.Array:
+        """Global row indices of the locally-held row block."""
+        return jnp.arange(n, dtype=jnp.int32)
+
+    def transpose(self, x: jax.Array) -> jax.Array:
+        """[rows=senders, N] -> [rows=receivers, N] reorientation."""
+        return x.T
+
+    def or_across(self, v: jax.Array) -> jax.Array:
+        """Combine per-device partial ORs of a replicated-shape vector."""
+        return v
+
+    def gather_rows(self, v_local: jax.Array) -> jax.Array:
+        """[local_rows] -> [N] (already global locally)."""
+        return v_local
+
+    def merge_reduce(self, recv_from, known, hb, ts, now, *,
+                     t_remove: int, block_size: int):
+        return gossip_reductions(recv_from, known, hb, ts, now,
+                                 t_remove=t_remove, block_size=block_size)
+
+
+class RingComm:
+    """Peer-axis-sharded execution inside ``shard_map``.
+
+    Must be used with every (N, N) table sharded as
+    ``P(axis_name, None)`` and every (N,) vector replicated.
+    ``n`` must be divisible by the mesh axis size.
+    """
+
+    def __init__(self, axis_name: str, n_shards: int):
+        self.axis = axis_name
+        self.n_shards = n_shards
+
+    def row_ids(self, n: int) -> jax.Array:
+        nl = n // self.n_shards
+        return jnp.arange(nl, dtype=jnp.int32) + lax.axis_index(self.axis) * nl
+
+    def transpose(self, x: jax.Array) -> jax.Array:
+        """Distributed transpose: sender-row-sharded [Nl, N] ->
+        receiver-row-sharded [Nl, N] via one all_to_all."""
+        nl, n = x.shape
+        p = self.n_shards
+        # [Nl_s, P, Nl_r] -> per-destination blocks on the leading axis
+        z = x.reshape(nl, p, nl).swapaxes(0, 1)          # [P, Nl_s, Nl_r]
+        w = lax.all_to_all(z, self.axis, 0, 0)           # [P, Nl_s, Nl_r] from each origin
+        # received block o is x_o[:, mine].  Transpose to receiver-major.
+        return w.transpose(2, 0, 1).reshape(nl, n)
+
+    def or_across(self, v: jax.Array) -> jax.Array:
+        return lax.psum(v.astype(jnp.int32), self.axis) > 0
+
+    def gather_rows(self, v_local: jax.Array) -> jax.Array:
+        return lax.all_gather(v_local, self.axis, tiled=True)
+
+    def merge_reduce(self, recv_from, known, hb, ts, now, *,
+                     t_remove: int, block_size: int):
+        """Ring max-accumulation over rotating payload blocks.
+
+        recv_from: [Nl_r, N] local receiver rows (post-transpose).
+        known/hb/ts: [Nl, N] local payload rows (this device's peers).
+        """
+        nl, n = known.shape
+        p = self.n_shards
+        me = lax.axis_index(self.axis)
+        perm = [(i, (i + 1) % p) for i in range(p)]
+
+        def step(k, carry):
+            m_all, m_fr, t_fr, anyf, kb, hbb, tsb = carry
+            # the rotating block currently holds rows of origin device o
+            o = (me - k) % p
+            cols = lax.dynamic_slice(recv_from, (0, o * nl), (nl, nl))
+            r = gossip_reductions(cols, kb, hbb, tsb, now,
+                                  t_remove=t_remove, block_size=block_size)
+            m_all = jnp.maximum(m_all, r[0])
+            m_fr = jnp.maximum(m_fr, r[1])
+            t_fr = jnp.maximum(t_fr, r[2])
+            anyf = anyf | r[3]
+            kb = lax.ppermute(kb, self.axis, perm)
+            hbb = lax.ppermute(hbb, self.axis, perm)
+            tsb = lax.ppermute(tsb, self.axis, perm)
+            return (m_all, m_fr, t_fr, anyf, kb, hbb, tsb)
+
+        # input-derived initializers: keep the fori_loop carry's
+        # varying-axis type consistent under shard_map (see ops/merge.py)
+        zero = recv_from[:, :1].astype(jnp.int32) * (hb[:1, :] * 0)
+        init = (zero + FILL, zero + FILL, zero + FILL, zero.astype(bool),
+                known, hb, ts)
+        m_all, m_fr, t_fr, anyf, *_ = lax.fori_loop(0, p, step, init)
+        return m_all, m_fr, t_fr, anyf

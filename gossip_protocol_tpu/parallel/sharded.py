@@ -1,0 +1,108 @@
+"""Sharded whole-run execution over a device mesh.
+
+Scale-out replacement for the reference's single-process emulation
+(SURVEY.md §2.3-2.4): the peer axis — and with it every row of the
+(N, N) membership tables — is sharded over a 1-D ``jax.sharding.Mesh``
+axis; (N,) vectors and the clock/key are replicated.  The whole
+700-tick ``lax.scan`` runs inside one ``shard_map``, so per tick the
+only cross-device traffic is one ``all_to_all`` (delivery transpose)
+and the ``ppermute`` ring of the merge reduction — all ICI-resident
+collectives, no host round-trips.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import SimConfig
+from ..core.tick import TickEvents, make_tick
+from ..state import Schedule, WorldState
+from .comm import RingComm
+
+PEER_AXIS = "peers"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = PEER_AXIS) -> Mesh:
+    """1-D mesh over the first ``n_devices`` available devices."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def _state_specs(axis: str) -> WorldState:
+    """PartitionSpecs per WorldState field: tables row-sharded, rest
+    replicated."""
+    mat = P(axis, None)
+    rep = P()
+    vec = P()
+    return WorldState(tick=rep, in_group=vec, own_hb=vec,
+                      known=mat, hb=mat, ts=mat,
+                      gossip=mat, joinreq=vec, joinrep=vec, rng=rep)
+
+
+def _sched_specs() -> Schedule:
+    return Schedule(start_tick=P(), fail_tick=P(), drop_active=P(),
+                    drop_prob=P())
+
+
+_SHARDED_CACHE: dict = {}
+
+
+def make_sharded_run(cfg: SimConfig, mesh: Mesh, block_size: int = 128,
+                     with_events: bool = True, axis: str = PEER_AXIS):
+    """Build ``run(state, sched) -> (final_state, events)`` with the
+    scan-over-ticks inside ``shard_map`` over ``mesh``.
+
+    Events come back row-sharded: ``added``/``removed`` have shape
+    [T, N//P, N] per device, i.e. logically [T, N, N] sharded on axis 1.
+    """
+    n_shards = mesh.devices.size
+    key = (cfg.n, cfg.t_remove, cfg.total_ticks, block_size, with_events,
+           n_shards, axis, id(mesh))
+    if key in _SHARDED_CACHE:
+        return _SHARDED_CACHE[key]
+
+    comm = RingComm(axis, n_shards)
+    tick = make_tick(cfg, block_size, comm=comm)
+
+    state_specs = _state_specs(axis)
+    ev_specs = TickEvents(added=P(None, axis, None),
+                          removed=P(None, axis, None),
+                          sent=P(None, axis), recv=P(None, axis))
+    if not with_events:
+        ev_specs = TickEvents(added=P(), removed=P(),
+                              sent=P(None, axis), recv=P(None, axis))
+
+    def body(state: WorldState, sched: Schedule):
+        def step(carry, _):
+            carry, ev = tick(carry, sched)
+            if not with_events:
+                ev = TickEvents(added=jnp.zeros((), bool),
+                                removed=jnp.zeros((), bool),
+                                sent=ev.sent, recv=ev.recv)
+            return carry, ev
+        return jax.lax.scan(step, state, None, length=cfg.total_ticks)
+
+    shmapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(state_specs, _sched_specs()),
+        out_specs=(state_specs, ev_specs),
+    )
+    run = jax.jit(shmapped)
+    _SHARDED_CACHE[key] = run
+    return run
+
+
+def shard_state(state: WorldState, mesh: Mesh, axis: str = PEER_AXIS) -> WorldState:
+    """Place a host/single-device WorldState onto the mesh with the
+    canonical shardings (call once before the run loop)."""
+    specs = _state_specs(axis)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs)

@@ -1,0 +1,161 @@
+"""World state: the entire simulation as a handful of device arrays.
+
+The reference scatters per-peer state across N heap-allocated ``Member``
+objects (Member.h:89-122), each holding a ``vector<MemberListEntry>``
+(Member.h:62-81) and an inbox queue, plus a shared in-flight message
+buffer (EmulNet.h:35-72).  Here the same information is a single pytree
+of dense arrays, batched over the peer axis, so one simulation tick is
+one XLA program:
+
+* ``known[i, j]``  — peer *i*'s member list contains peer *j*
+  (replaces ``vector<MemberListEntry>`` membership).
+* ``hb[i, j]``     — the heartbeat value *i* has recorded for *j*
+  (``MemberListEntry::heartbeat``, Member.h:66).
+* ``ts[i, j]``     — the local-clock timestamp of *i*'s entry for *j*
+  (``MemberListEntry::timestamp``, Member.h:67).
+* ``in_group[i]``  — ``Member::inGroup`` (Member.h:95).
+* ``own_hb[i]``    — ``Member::heartbeat`` (Member.h:101).  Write-only in
+  the reference too: the sender's own heartbeat is never transmitted
+  (MP1Node.cpp:355-358 sends only the member list, which excludes self);
+  receivers *increment* their own counter for the sender instead
+  (MP1Node.cpp:236-239).  Kept for parity and metrics.
+* ``gossip[s, r]`` — a GOSSIP message from *s* to *r* is in flight
+  (sent during the previous tick, consumed this tick).  The payload is
+  *s*'s row of ``known/hb/ts`` — which is exactly the carried state from
+  the end of the previous tick, so no copy is needed.  This replaces the
+  EmulNet buffer (EmulNet.h:35-72) for gossip traffic.
+* ``joinreq[i]``   — peer *i*'s JOINREQ to the introducer is in flight
+  (MP1Node.cpp:135-149).
+* ``joinrep[i]``   — a JOINREP to peer *i* is in flight (MP1Node.cpp:225-229).
+* ``rng``          — PRNG key for the drop mask; replaces ``rand()``
+  (EmulNet.cpp:90) with a per-tick folded key so runs are reproducible.
+
+Timestamps use the global logical clock (``Params::getcurrtime``,
+Params.cpp:48-50); all peers share it, as in the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from .config import INTRODUCER, SimConfig
+
+
+@struct.dataclass
+class WorldState:
+    """Carried state of the simulation (one pytree node per array above)."""
+
+    tick: jax.Array      # i32 scalar — the global logical clock
+    in_group: jax.Array  # bool[N]
+    own_hb: jax.Array    # i32[N]
+    known: jax.Array     # bool[N, N]
+    hb: jax.Array        # i32[N, N]
+    ts: jax.Array        # i32[N, N]
+    gossip: jax.Array    # bool[N, N]  (sender, receiver)
+    joinreq: jax.Array   # bool[N]
+    joinrep: jax.Array   # bool[N]
+    rng: jax.Array       # PRNG key
+
+    @property
+    def n(self) -> int:
+        return self.known.shape[0]
+
+
+@struct.dataclass
+class Schedule:
+    """Per-run injection schedule, precomputed on host.
+
+    Replaces ``Application::fail`` (Application.cpp:173-202) and the
+    staggered introduction logic (Application.cpp:143-148) with data:
+    the tick function consumes these arrays instead of branching on
+    host-side RNG.
+    """
+
+    start_tick: jax.Array   # i32[N] — node i introduced at this tick (Application.cpp:143)
+    fail_tick: jax.Array    # i32[N] — bFailed flips at the END of this tick
+                            #          (fail() runs after mp1Run, Application.cpp:99-104);
+                            #          a huge sentinel means "never fails"
+    drop_active: jax.Array  # bool[T] — dropmsg flag value during tick t's sends
+    drop_prob: jax.Array    # f32 scalar — MSG_DROP_PROB
+
+    def failed_at(self, t: jax.Array) -> jax.Array:
+        """bool[N]: is peer i failed while processing tick ``t``?
+
+        ``fail()`` flips ``bFailed`` after tick ``fail_tick`` completes
+        (Application.cpp:99-104,181-196), so the flag is observed from
+        tick ``fail_tick + 1`` on.
+        """
+        return t > self.fail_tick
+
+
+NEVER = np.iinfo(np.int32).max  # sentinel fail_tick for peers that never fail
+
+
+def make_schedule(cfg: SimConfig, rng: np.random.RandomState | None = None) -> Schedule:
+    """Build the injection schedule for a scenario.
+
+    Mirrors ``Application::fail`` semantics exactly:
+
+    * single failure: one uniformly random victim at ``fail_tick``
+      (Application.cpp:181-187);
+    * multi failure: a contiguous block ``[r, r + N/2)`` with
+      ``r = (rand() % N) / 2`` (C precedence, Application.cpp:189-190);
+    * drop window: the ``dropmsg`` flag is set *after* tick 50 and
+      cleared *after* tick 300 (Application.cpp:177-179,198-200), so
+      sends are droppable for ticks in ``[51, 300]`` inclusive.
+    """
+    n = cfg.n
+    rng = rng or np.random.RandomState(cfg.seed)
+    start = np.array([cfg.start_tick(i) for i in range(n)], np.int32)
+    fail = np.full(n, NEVER, np.int32)
+    if cfg.single_failure:
+        victim = int(rng.randint(n))
+        fail[victim] = cfg.fail_tick
+    else:
+        r = int(rng.randint(n)) // 2
+        fail[r: r + n // 2] = cfg.fail_tick
+    t = np.arange(cfg.total_ticks, dtype=np.int32)
+    drop = np.zeros(cfg.total_ticks, bool)
+    if cfg.drop_msg:
+        drop = (t > cfg.drop_open_tick) & (t <= cfg.drop_close_tick)
+    return Schedule(
+        start_tick=jnp.asarray(start),
+        fail_tick=jnp.asarray(fail),
+        drop_active=jnp.asarray(drop),
+        drop_prob=jnp.float32(cfg.msg_drop_prob),
+    )
+
+
+def init_state(cfg: SimConfig) -> WorldState:
+    """Fresh world state at tick 0 (before anything has happened).
+
+    Matches ``MP1Node::initThisNode`` (MP1Node.cpp:95-113): empty member
+    lists, heartbeat 0, nobody in-group; the introducer only joins the
+    group when its start tick fires inside the tick function
+    (MP1Node.cpp:126-132).
+    """
+    n = cfg.n
+    return WorldState(
+        tick=jnp.int32(0),
+        in_group=jnp.zeros(n, bool),
+        own_hb=jnp.zeros(n, jnp.int32),
+        known=jnp.zeros((n, n), bool),
+        hb=jnp.zeros((n, n), jnp.int32),
+        ts=jnp.zeros((n, n), jnp.int32),
+        gossip=jnp.zeros((n, n), bool),
+        joinreq=jnp.zeros(n, bool),
+        joinrep=jnp.zeros(n, bool),
+        rng=jax.random.PRNGKey(cfg.seed),
+    )
+
+
+def state_to_host(state: WorldState) -> dict[str, np.ndarray]:
+    """Device state -> plain numpy dict (for checkpointing / debugging)."""
+    return {f.name: np.asarray(getattr(state, f.name))
+            for f in dataclasses.fields(WorldState)}

@@ -1,0 +1,41 @@
+"""Shared drop-decision precomputation for differential tests.
+
+Replays the tick function's exact PRNG usage (core/tick.py: per-tick
+``fold_in`` + 3-way split, gossip/joinreq/joinrep masks in that order)
+so the scalar oracle can consume the very same drop decisions the
+vectorized simulation will draw on device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import SimConfig
+from ..state import Schedule
+
+
+def make_drop_masks(cfg: SimConfig, sched: Schedule):
+    """Returns (gossip_drop[T,N,N], joinreq_drop[T,N], joinrep_drop[T,N])
+    boolean numpy arrays: True = that send would be dropped."""
+    n, t_total = cfg.n, cfg.total_ticks
+    base = jax.random.PRNGKey(cfg.seed)
+    active = np.asarray(sched.drop_active)
+    p = float(sched.drop_prob)
+
+    g = np.zeros((t_total, n, n), bool)
+    q = np.zeros((t_total, n), bool)
+    r = np.zeros((t_total, n), bool)
+    rows = jnp.arange(n, dtype=jnp.int32)
+    row_uniform = jax.jit(jax.vmap(
+        lambda k, row: jax.random.uniform(jax.random.fold_in(k, row), (n,)),
+        in_axes=(None, 0)))
+    for t in range(t_total):
+        if not active[t]:
+            continue
+        kg, kq, kp = jax.random.split(jax.random.fold_in(base, t), 3)
+        g[t] = np.asarray(row_uniform(kg, rows) < p)
+        q[t] = np.asarray(jax.random.uniform(kq, (n,)) < p)
+        r[t] = np.asarray(jax.random.uniform(kp, (n,)) < p)
+    return g, q, r

@@ -1,0 +1,48 @@
+"""Test harness configuration.
+
+Tests run on CPU with 8 virtual devices so the multi-chip sharding paths
+compile and execute without TPU hardware (the driver's dryrun does the
+same).  These env vars must be set before jax is first imported.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# This image's sitecustomize registers a tunneled TPU PJRT plugin in every
+# interpreter and latches JAX_PLATFORMS before conftest runs; its backend
+# grabs the (single-grant) device on first use, serializing all jax
+# processes machine-wide.  Tests are CPU-only by design — force the
+# platform list through the live config so the tunnel is never dialed.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from gossip_protocol_tpu.config import SimConfig  # noqa: E402
+
+TESTCASES = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                         "testcases")
+
+
+@pytest.fixture(scope="session")
+def testcases_dir():
+    return TESTCASES
+
+
+def scenario_cfg(name: str, **kw) -> SimConfig:
+    return SimConfig.from_conf(os.path.join(TESTCASES, f"{name}.conf"), **kw)
+
+
+@pytest.fixture(params=["singlefailure", "multifailure", "msgdropsinglefailure"])
+def scenario(request):
+    return request.param

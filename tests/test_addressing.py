@@ -1,0 +1,28 @@
+"""Address model: 0-based peer index <-> reference id/byte forms
+(Member.h:29-55, EmulNet.cpp:72-77, Log.cpp:73)."""
+
+from gossip_protocol_tpu.addressing import (addr_str, display_addr,
+                                            parse_addr, peer_id, peer_index)
+
+
+def test_sequential_ids():
+    assert peer_id(0) == 1  # introducer (Application.cpp:209-217)
+    assert peer_index(peer_id(41)) == 41
+
+
+def test_addr_str_little_endian_bytes():
+    assert addr_str(0) == "1.0.0.0:0"
+    assert addr_str(9) == "10.0.0.0:0"
+    assert addr_str(255) == "0.1.0.0:0"       # id 256 -> bytes 0,1,0,0
+    assert addr_str(256 + 255) == "0.2.0.0:0"  # id 512
+
+
+def test_roundtrip():
+    for i in (0, 9, 99, 65535, 1_000_000 - 1):
+        assert parse_addr(addr_str(i)) == i
+
+
+def test_display_addr():
+    # Address::getAddress form used on stdout (Member.h:46-52)
+    assert display_addr(0) == "1:0"
+    assert display_addr(9) == "10:0"

@@ -1,0 +1,48 @@
+"""Config system: legacy .conf ingestion and derived semantics
+(reference: Params.cpp:19-50, Application.cpp:143)."""
+
+import pytest
+
+from gossip_protocol_tpu.config import SimConfig
+from tests.conftest import scenario_cfg
+
+
+def test_parse_singlefailure():
+    cfg = scenario_cfg("singlefailure")
+    assert cfg.max_nnb == 10 and cfg.n == 10
+    assert cfg.single_failure and not cfg.drop_msg
+    assert cfg.msg_drop_prob == pytest.approx(0.1)
+
+
+def test_parse_multifailure():
+    cfg = scenario_cfg("multifailure")
+    assert not cfg.single_failure and not cfg.drop_msg
+
+
+def test_parse_msgdrop():
+    cfg = scenario_cfg("msgdropsinglefailure")
+    assert cfg.single_failure and cfg.drop_msg
+    assert cfg.msg_drop_prob == pytest.approx(0.1)
+
+
+def test_reference_constants():
+    cfg = SimConfig()
+    # Params.cpp:29-31, Application.h:27, MP1Node.h:21-22, EmulNet.h:12
+    assert cfg.total_ticks == 700
+    assert cfg.step_rate == 0.25
+    assert cfg.t_remove == 20
+    assert cfg.t_fail == 5
+    assert cfg.max_msg_size == 4000
+    assert cfg.en_buff_size == 30000
+    assert cfg.portnum == 8001
+
+
+def test_start_tick_truncation():
+    """Node i starts at C-truncated int(0.25*i) (Application.cpp:143)."""
+    cfg = SimConfig()
+    assert [cfg.start_tick(i) for i in range(10)] == [0, 0, 0, 0, 1, 1, 1, 1, 2, 2]
+
+
+def test_overrides():
+    cfg = scenario_cfg("singlefailure", max_nnb=512, seed=7)
+    assert cfg.n == 512 and cfg.seed == 7

@@ -1,0 +1,59 @@
+"""Message-loss injection: window semantics and statistics
+(EmulNet.cpp:90-94, Application.cpp:177-200)."""
+
+import numpy as np
+
+from gossip_protocol_tpu.core.sim import Simulation
+from gossip_protocol_tpu.state import make_schedule
+from tests.conftest import scenario_cfg
+
+
+def test_window_exact():
+    """dropmsg is flipped *after* ticks 50 and 300 (fail() runs after
+    mp1Run, Application.cpp:99-104), so sends are droppable exactly for
+    ticks 51..300 inclusive."""
+    cfg = scenario_cfg("msgdropsinglefailure")
+    sched = make_schedule(cfg)
+    active = np.asarray(sched.drop_active)
+    assert not active[:51].any()
+    assert active[51:301].all()
+    assert not active[301:].any()
+
+
+def test_no_drops_outside_window():
+    cfg = scenario_cfg("msgdropsinglefailure", seed=0)
+    res = Simulation(cfg).run()
+    # outside the window every live in-group sender emits exactly
+    # len(member list) gossips; with N=10 steady state that is 9/tick.
+    steady_pre = res.sent[:, 40:50]
+    assert (steady_pre == 9).all()
+    post = res.sent[:, 320:330]
+    failed = set(np.nonzero(res.fail_tick < 2**31 - 1)[0])
+    for i in range(cfg.n):
+        expect = 0 if i in failed else 9 - len(failed)
+        assert (post[i] == expect).all()
+
+
+def test_drop_rate_statistics():
+    """Inside the window the observed drop rate must be ~MSG_DROP_PROB."""
+    cfg = scenario_cfg("msgdropsinglefailure", seed=1)
+    res = Simulation(cfg).run()
+    window = res.sent[:, 60:95]  # before the failure, all 10 alive
+    total = window.sum()
+    expected = 10 * 9 * 35  # attempts
+    rate = 1 - total / expected
+    assert 0.05 < rate < 0.15  # p=0.1, ~3150 attempts
+
+
+def test_drop_only_affects_delivery_not_state():
+    """A dropped gossip must not update the receiver (no phantom
+    refreshes): with 100% drop inside the window, every survivor's
+    entries go stale and get removed TREMOVE after the window opens."""
+    cfg = scenario_cfg("msgdropsinglefailure", seed=2, msg_drop_prob=1.0)
+    res = Simulation(cfg).run()
+    gv = res.grader_view()
+    # last refresh at t=51 (sends of tick 50 delivered), removal when
+    # t - 51 >= 20 -> tick 71, for *all* peers' entries
+    early = {t for (obs, subj), t in gv["removal_ticks"].items()}
+    assert 71 in early
+    assert min(early) == 71

@@ -1,0 +1,100 @@
+"""Unit/property tests for the gossip merge reduction (ops/merge.py) —
+the kernel the reference lacks unit tests for (SURVEY.md §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gossip_protocol_tpu.ops.merge import FILL, gossip_reductions
+
+
+def brute_force(recv_from, known, hb, ts, now, t_remove):
+    r_dim, s_dim = recv_from.shape
+    j_dim = known.shape[1]
+    m_all = np.full((r_dim, j_dim), -1, np.int32)
+    m_fr = np.full((r_dim, j_dim), -1, np.int32)
+    t_fr = np.full((r_dim, j_dim), -1, np.int32)
+    anyf = np.zeros((r_dim, j_dim), bool)
+    for r in range(r_dim):
+        for s in range(s_dim):
+            if not recv_from[r, s]:
+                continue
+            for j in range(j_dim):
+                if not known[s, j]:
+                    continue
+                m_all[r, j] = max(m_all[r, j], hb[s, j])
+                if now - ts[s, j] < t_remove:
+                    m_fr[r, j] = max(m_fr[r, j], hb[s, j])
+                    t_fr[r, j] = max(t_fr[r, j], ts[s, j])
+                    anyf[r, j] = True
+    return m_all, m_fr, t_fr, anyf
+
+
+@pytest.mark.parametrize("n,block", [(7, 128), (16, 4), (33, 8), (64, 64)])
+def test_matches_brute_force(n, block):
+    rng = np.random.RandomState(n)
+    recv_from = rng.rand(n, n) < 0.4
+    known = rng.rand(n, n) < 0.6
+    hb = rng.randint(1, 100, (n, n)).astype(np.int32)
+    ts = rng.randint(0, 50, (n, n)).astype(np.int32)
+    now = 45
+    got = gossip_reductions(jnp.asarray(recv_from), jnp.asarray(known),
+                            jnp.asarray(hb), jnp.asarray(ts), jnp.int32(now),
+                            t_remove=20, block_size=block)
+    want = brute_force(recv_from, known, hb, ts, now, 20)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), w)
+
+
+def test_block_size_invariance():
+    """The reduction must not depend on the blocking (padding included)."""
+    rng = np.random.RandomState(0)
+    n = 30
+    args = (jnp.asarray(rng.rand(n, n) < 0.5), jnp.asarray(rng.rand(n, n) < 0.5),
+            jnp.asarray(rng.randint(1, 9, (n, n)), jnp.int32),
+            jnp.asarray(rng.randint(0, 40, (n, n)), jnp.int32), jnp.int32(35))
+    ref = gossip_reductions(*args, t_remove=20, block_size=n)
+    for b in (1, 3, 7, 16, 128):
+        got = gossip_reductions(*args, t_remove=20, block_size=b)
+        for g, w in zip(got, ref):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_merge_is_max_semiring():
+    """Gossip merge is a (max, and) semiring reduction — commutative in
+    senders and idempotent: merging the same payload twice changes
+    nothing.  This is the property that makes the batched formulation
+    (and the sharded ring version) equivalent to any sequential
+    message order."""
+    rng = np.random.RandomState(1)
+    n = 12
+    recv = rng.rand(n, n) < 0.5
+    known = rng.rand(n, n) < 0.5
+    hb = rng.randint(1, 50, (n, n)).astype(np.int32)
+    ts = rng.randint(0, 30, (n, n)).astype(np.int32)
+    base = gossip_reductions(jnp.asarray(recv), jnp.asarray(known),
+                             jnp.asarray(hb), jnp.asarray(ts), jnp.int32(25),
+                             t_remove=20)
+    # sender permutation invariance
+    perm = rng.permutation(n)
+    permd = gossip_reductions(jnp.asarray(recv[:, perm]), jnp.asarray(known[perm]),
+                              jnp.asarray(hb[perm]), jnp.asarray(ts[perm]),
+                              jnp.int32(25), t_remove=20)
+    for g, w in zip(base, permd):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    # idempotence: duplicating every sender leaves the maxima unchanged
+    dup = gossip_reductions(jnp.asarray(np.concatenate([recv, recv], 1)),
+                            jnp.asarray(np.concatenate([known, known], 0)),
+                            jnp.asarray(np.concatenate([hb, hb], 0)),
+                            jnp.asarray(np.concatenate([ts, ts], 0)),
+                            jnp.int32(25), t_remove=20)
+    for g, w in zip(base, dup):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_no_contribution_is_fill():
+    got = gossip_reductions(jnp.zeros((3, 3), bool), jnp.ones((3, 3), bool),
+                            jnp.ones((3, 3), jnp.int32), jnp.zeros((3, 3), jnp.int32),
+                            jnp.int32(5), t_remove=20)
+    assert (np.asarray(got[0]) == int(FILL)).all()
+    assert not np.asarray(got[3]).any()
