@@ -15,9 +15,10 @@ These files are the reference's observability surface and external API:
   ENcleanup format (EmulNet.cpp:184-220), including the 10-per-line
   wrapping and the bizarre node-67 "special" row.
 
-A C fast path for bulk event formatting lives in ``native/logsink.c``;
-this module is the always-available pure-Python implementation and the
-single source of truth for the grammar.
+This module is the grammar's single source of truth on the Python side;
+the native runtime carries an independent implementation of the same
+grammar (``native/logsink.cc``) used by the C++ engine, and
+tests/test_native.py asserts the two stay byte-compatible.
 """
 
 from __future__ import annotations
@@ -55,15 +56,7 @@ def format_events(events: Iterable[LogEvent], bug_compat: bool = True) -> str:
 def write_dbg_log(events: Iterable[LogEvent], outdir: str = ".",
                   bug_compat: bool = True) -> str:
     path = os.path.join(outdir, DBG_LOG)
-    text = None
-    try:  # native fast path (optional)
-        from . import _native  # type: ignore
-        text = _native.format_events(
-            [(ev.observer, ev.tick, ev.text) for ev in events], bug_compat)
-    except Exception:
-        pass
-    if text is None:
-        text = format_events(events, bug_compat)
+    text = format_events(events, bug_compat)
     with open(path, "w") as f:
         f.write(text)
     # stats.log is opened alongside dbg.log and stays empty (Log.cpp:66-67)

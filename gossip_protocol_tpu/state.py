@@ -97,7 +97,7 @@ class Schedule:
 NEVER = np.iinfo(np.int32).max  # sentinel fail_tick for peers that never fail
 
 
-def make_schedule(cfg: SimConfig, rng: np.random.RandomState | None = None) -> Schedule:
+def make_schedule(cfg: SimConfig) -> Schedule:
     """Build the injection schedule for a scenario.
 
     Mirrors ``Application::fail`` semantics exactly:
@@ -109,16 +109,22 @@ def make_schedule(cfg: SimConfig, rng: np.random.RandomState | None = None) -> S
     * drop window: the ``dropmsg`` flag is set *after* tick 50 and
       cleared *after* tick 300 (Application.cpp:177-179,198-200), so
       sends are droppable for ticks in ``[51, 300]`` inclusive.
+
+    Victim selection draws from the counter-based hash PRNG shared with
+    the native engine (utils/prng.py == native/engine.cc), so the same
+    seed yields the same schedule on every backend.
     """
+    from .utils.prng import fail_schedule_uniform
+
     n = cfg.n
-    rng = rng or np.random.RandomState(cfg.seed)
     start = np.array([cfg.start_tick(i) for i in range(n)], np.int32)
     fail = np.full(n, NEVER, np.int32)
+    u = fail_schedule_uniform(cfg.seed)
     if cfg.single_failure:
-        victim = int(rng.randint(n))
+        victim = int(u * n) % n
         fail[victim] = cfg.fail_tick
     else:
-        r = int(rng.randint(n)) // 2
+        r = (int(u * n) % n) // 2
         fail[r: r + n // 2] = cfg.fail_tick
     t = np.arange(cfg.total_ticks, dtype=np.int32)
     drop = np.zeros(cfg.total_ticks, bool)

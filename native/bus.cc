@@ -1,0 +1,172 @@
+#include "bus.h"
+
+#include <cstring>
+
+#include "logsink.h"
+
+namespace gossip {
+
+double HashUniform(uint64_t seed, uint64_t a, uint64_t b, uint64_t c,
+                   uint64_t d) {
+  // Mix the key material with distinct odd constants, then apply the
+  // splitmix64 finalizer.  Counter-based: no sequential state, so any
+  // (tick, from, to, salt) decision can be recomputed independently.
+  uint64_t x = seed;
+  x += 0x9E3779B97F4A7C15ULL * (a + 1);
+  x += 0xBF58476D1CE4E5B9ULL * (b + 1);
+  x += 0x94D049BB133111EBULL * (c + 1);
+  x += 0xD6E8FEB86659FD93ULL * (d + 1);
+  x ^= x >> 30;
+  x *= 0xBF58476D1CE4E5B9ULL;
+  x ^= x >> 27;
+  x *= 0x94D049BB133111EBULL;
+  x ^= x >> 31;
+  // 53-bit mantissa -> [0, 1)
+  return static_cast<double>(x >> 11) * (1.0 / 9007199254740992.0);
+}
+
+Bus::Bus(int max_nodes, int total_ticks, Limits limits, double drop_prob,
+         uint64_t seed)
+    : max_nodes_(max_nodes),
+      total_ticks_(total_ticks),
+      limits_(limits),
+      drop_prob_(drop_prob),
+      seed_(seed),
+      inbox_(max_nodes),
+      sent_(static_cast<size_t>(max_nodes) * total_ticks, 0),
+      recv_(static_cast<size_t>(max_nodes) * total_ticks, 0) {}
+
+int Bus::Init() {
+  if (next_id_ >= max_nodes_) return -1;
+  return next_id_++;
+}
+
+bool Bus::Send(int from, int to, const uint8_t* data, size_t size, int tick,
+               bool drop_active, int channel) {
+  if (to < 0 || to >= next_id_ || from < 0 || from >= next_id_) return false;
+  // The three silent-drop conditions (EmulNet.cpp:92-94): full buffer,
+  // oversize payload, Bernoulli drop inside the window.
+  if (inflight_ >= limits_.max_inflight) return false;
+  if (size > static_cast<size_t>(limits_.max_msg_size)) return false;
+  if (drop_active) {
+    bool drop = drop_hook_
+                    ? drop_hook_(from, to, tick, channel)
+                    : HashUniform(seed_, tick, from, to, channel) < drop_prob_;
+    if (drop) return false;
+  }
+  inbox_[to].emplace_back(data, data + size);
+  ++inflight_;
+  if (tick >= 0 && tick < total_ticks_) {
+    ++sent_[static_cast<size_t>(from) * total_ticks_ + tick];
+  }
+  return true;
+}
+
+int Bus::Recv(int me, int tick,
+              const std::function<void(const uint8_t*, size_t)>& cb) {
+  if (me < 0 || me >= next_id_) return 0;
+  int delivered = 0;
+  auto& q = inbox_[me];
+  while (!q.empty()) {
+    std::vector<uint8_t> msg = std::move(q.front());
+    q.pop_front();
+    --inflight_;
+    ++delivered;
+    if (tick >= 0 && tick < total_ticks_) {
+      ++recv_[static_cast<size_t>(me) * total_ticks_ + tick];
+    }
+    cb(msg.data(), msg.size());
+  }
+  return delivered;
+}
+
+int Bus::RecvBounded(int me, int tick, uint8_t* out, size_t out_cap,
+                     int* sizes, int sizes_cap, bool* more) {
+  if (more != nullptr) *more = false;
+  if (me < 0 || me >= next_id_) return 0;
+  auto& q = inbox_[me];
+  size_t used = 0;
+  int count = 0;
+  while (!q.empty()) {
+    const auto& front = q.front();
+    if (count >= sizes_cap || used + front.size() > out_cap) {
+      if (more != nullptr) *more = true;
+      break;
+    }
+    std::memcpy(out + used, front.data(), front.size());
+    used += front.size();
+    sizes[count++] = static_cast<int>(front.size());
+    q.pop_front();
+    --inflight_;
+    if (tick >= 0 && tick < total_ticks_) {
+      ++recv_[static_cast<size_t>(me) * total_ticks_ + tick];
+    }
+  }
+  return count;
+}
+
+bool Bus::Cleanup(const std::string& outdir) const {
+  return WriteMsgCount(outdir, sent_.data(), recv_.data(), next_id_,
+                       total_ticks_);
+}
+
+}  // namespace gossip
+
+// ---- C ABI -----------------------------------------------------------
+
+struct gp_bus {
+  gossip::Bus impl;
+};
+
+extern "C" {
+
+gp_bus* gp_bus_create(int max_nodes, int total_ticks, int max_inflight,
+                      int max_msg_size, double drop_prob, uint64_t seed) {
+  gossip::Bus::Limits lim;
+  if (max_inflight > 0) lim.max_inflight = max_inflight;
+  if (max_msg_size > 0) lim.max_msg_size = max_msg_size;
+  return new gp_bus{gossip::Bus(max_nodes, total_ticks, lim, drop_prob, seed)};
+}
+
+void gp_bus_destroy(gp_bus* bus) { delete bus; }
+
+int gp_bus_init(gp_bus* bus) { return bus->impl.Init(); }
+
+int gp_bus_send(gp_bus* bus, int from, int to, const void* data, int size,
+                int tick, int drop_active, int channel) {
+  return bus->impl.Send(from, to, static_cast<const uint8_t*>(data),
+                        static_cast<size_t>(size), tick, drop_active != 0,
+                        channel)
+             ? 1
+             : 0;
+}
+
+int gp_bus_recv(gp_bus* bus, int me, int tick, void* out, int out_cap,
+                int* sizes, int sizes_cap, int* more) {
+  bool m = false;
+  int count = bus->impl.RecvBounded(me, tick, static_cast<uint8_t*>(out),
+                                    static_cast<size_t>(out_cap), sizes,
+                                    sizes_cap, &m);
+  if (more != nullptr) *more = m ? 1 : 0;
+  return count;
+}
+
+int gp_bus_inflight(const gp_bus* bus) { return bus->impl.inflight(); }
+
+int gp_bus_cleanup(const gp_bus* bus, const char* outdir) {
+  return bus->impl.Cleanup(outdir) ? 1 : 0;
+}
+
+void gp_bus_counters(const gp_bus* bus, uint32_t* sent, uint32_t* recv) {
+  const auto& s = bus->impl.sent_matrix();
+  const auto& r = bus->impl.recv_matrix();
+  std::memcpy(sent, s.data(), s.size() * sizeof(uint32_t));
+  std::memcpy(recv, r.data(), r.size() * sizeof(uint32_t));
+}
+
+double gp_hash_uniform(uint64_t seed, uint64_t a, uint64_t b, uint64_t c,
+                       uint64_t d) {
+  return gossip::HashUniform(seed, a, b, c, d);
+}
+
+}  // extern "C"

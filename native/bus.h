@@ -1,0 +1,129 @@
+// EmulNet-shaped message bus: the native communication backend.
+//
+// Same plugin boundary as the reference's EmulNet (ENinit / ENsend /
+// ENrecv / ENcleanup, reference EmulNet.h:92-96) with the same
+// unreliable-datagram semantics — silent drop on buffer-full, oversize,
+// or Bernoulli probability inside the drop window (EmulNet.cpp:92-94);
+// store-and-forward delivery at the receiver's next recv pass; per-node/
+// per-tick send/recv accounting dumped as msgcount.log (EmulNet.cpp:184-220).
+//
+// Designed fresh rather than translated:
+//  * messages are real serialized bytes (wire.h), not aliased pointers;
+//  * per-destination queues replace the reference's single flat array
+//    scanned O(buffer) by every node every tick (EmulNet.cpp:151-174) —
+//    recv is O(inbox), and delivery preserves send order (the reference's
+//    swap-pop shuffles order; the protocol tolerates both);
+//  * the drop decision is a pure hash of (seed, tick, from, to, salt) —
+//    a counter-based splitmix64 PRNG — so runs are reproducible and the
+//    exact same decisions can be replayed from Python for differential
+//    tests (the reference's rand()-after-srand(time(NULL)) is neither,
+//    Application.cpp:50, EmulNet.cpp:90);
+//  * a test hook can override the drop decision per message.
+//
+// The C ABI at the bottom exposes the bus to ctypes for the Python-side
+// plugin tests.
+#pragma once
+
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <string>
+#include <vector>
+
+namespace gossip {
+
+// Counter-based uniform in [0, 1): splitmix64 finalizer over a key mix.
+// Public-domain bit-mixing constants (Stafford/Steele); no stream state.
+double HashUniform(uint64_t seed, uint64_t a, uint64_t b, uint64_t c,
+                   uint64_t d);
+
+class Bus {
+ public:
+  struct Limits {
+    int max_inflight = 30000;  // ENBUFFSIZE (EmulNet.h:12)
+    int max_msg_size = 4000;   // MAX_MSG_SIZE (Params.cpp:31)
+  };
+
+  // drop_hook(from, to, tick, channel) -> true to drop; installed by
+  // tests to replay externally-computed (e.g. device-PRNG) drop patterns.
+  using DropHook = std::function<bool(int, int, int, int)>;
+
+  Bus(int max_nodes, int total_ticks, Limits limits, double drop_prob,
+      uint64_t seed);
+
+  // ENinit (EmulNet.cpp:72-77): registers the next peer; returns its
+  // 0-based index (the reference returns a 1-based id; the off-by-one
+  // lives only at the logging boundary, addressing.py).
+  int Init();
+
+  // ENsend (EmulNet.cpp:87-111).  Returns true iff enqueued.
+  // `drop_active` is the caller's dropmsg-window flag (Params.h);
+  // `channel` salts the drop decision so distinct message classes draw
+  // independent Bernoulli trials (as the device engine's split keys do,
+  // core/tick.py).
+  bool Send(int from, int to, const uint8_t* data, size_t size, int tick,
+            bool drop_active, int channel = 0);
+
+  // ENrecv (EmulNet.cpp:144-177): deliver every queued message for `me`
+  // to the callback, in send order.  Returns messages delivered.
+  int Recv(int me, int tick,
+           const std::function<void(const uint8_t*, size_t)>& cb);
+
+  // Bounded variant for the C ABI: consumes messages only while they fit
+  // the caller's buffers, leaving the rest queued (retryable — unlike a
+  // drain-then-discard, nothing is lost on a short buffer).  Writes
+  // payloads back-to-back into out and per-message sizes into sizes;
+  // returns the count consumed; *more is set if messages remain.
+  int RecvBounded(int me, int tick, uint8_t* out, size_t out_cap, int* sizes,
+                  int sizes_cap, bool* more);
+
+  // ENcleanup (EmulNet.cpp:184-220): dump msgcount.log.
+  bool Cleanup(const std::string& outdir) const;
+
+  void set_drop_hook(DropHook hook) { drop_hook_ = std::move(hook); }
+
+  int inflight() const { return inflight_; }
+  const std::vector<uint32_t>& sent_matrix() const { return sent_; }
+  const std::vector<uint32_t>& recv_matrix() const { return recv_; }
+  int n_nodes() const { return next_id_; }
+
+ private:
+  int max_nodes_;
+  int total_ticks_;
+  Limits limits_;
+  double drop_prob_;
+  uint64_t seed_;
+  int next_id_ = 0;
+  int inflight_ = 0;
+  DropHook drop_hook_;
+  std::vector<std::deque<std::vector<uint8_t>>> inbox_;  // per-destination
+  std::vector<uint32_t> sent_;  // [node][tick], row-major
+  std::vector<uint32_t> recv_;
+};
+
+}  // namespace gossip
+
+// ---- C ABI (ctypes surface) -----------------------------------------
+extern "C" {
+typedef struct gp_bus gp_bus;
+
+gp_bus* gp_bus_create(int max_nodes, int total_ticks, int max_inflight,
+                      int max_msg_size, double drop_prob, uint64_t seed);
+void gp_bus_destroy(gp_bus* bus);
+int gp_bus_init(gp_bus* bus);  // -> new 0-based peer index, or -1
+int gp_bus_send(gp_bus* bus, int from, int to, const void* data, int size,
+                int tick, int drop_active,
+                int channel);  // -> 1 sent / 0 dropped
+// Consume messages for `me` into out (concatenated) while they fit,
+// writing each message's size into sizes.  Messages that don't fit stay
+// queued (*more != 0) — call again with fresh buffers.  Returns the
+// count consumed.
+int gp_bus_recv(gp_bus* bus, int me, int tick, void* out, int out_cap,
+                int* sizes, int sizes_cap, int* more);
+int gp_bus_inflight(const gp_bus* bus);
+int gp_bus_cleanup(const gp_bus* bus, const char* outdir);
+// Copy the (n, t_total) accounting matrices into caller buffers.
+void gp_bus_counters(const gp_bus* bus, uint32_t* sent, uint32_t* recv);
+double gp_hash_uniform(uint64_t seed, uint64_t a, uint64_t b, uint64_t c,
+                       uint64_t d);
+}

@@ -1,0 +1,288 @@
+#include "engine.h"
+
+#include <climits>
+#include <cstdio>
+#include <cstring>
+
+#include "wire.h"
+
+namespace gossip {
+
+namespace {
+constexpr int kIntroducer = 0;  // join address id=1 -> index 0
+                                // (Application.cpp:209-217, EmulNet.cpp:74)
+}
+
+Engine::Engine(const Params& par, std::vector<int32_t> fail_ticks)
+    : par_(par),
+      n_(par.n()),
+      bus_(par.n(), par.total_ticks,
+           Bus::Limits{par.en_buff_size, par.max_msg_size}, par.msg_drop_prob,
+           par.seed),
+      start_at_(n_),
+      fail_at_(std::move(fail_ticks)),
+      failed_(n_, 0),
+      in_group_(n_, 0),
+      own_hb_(n_, 0),
+      known_(static_cast<size_t>(n_) * n_, 0),
+      hb_(static_cast<size_t>(n_) * n_, 0),
+      ts_(static_cast<size_t>(n_) * n_, 0),
+      inbox_(n_) {
+  for (int i = 0; i < n_; ++i) {
+    start_at_[i] = par_.start_tick(i);
+    bus_.Init();
+  }
+  if (fail_at_.empty()) {
+    // Scenario schedule (Application::fail semantics, Application.cpp:181-196)
+    // with the framework's seeded counter PRNG in place of rand().
+    fail_at_.assign(n_, INT32_MAX);
+    double u = HashUniform(par_.seed, 0, 0, 0, /*salt=*/7);
+    if (par_.single_failure) {
+      int victim = static_cast<int>(u * n_) % n_;
+      fail_at_[victim] = par_.fail_tick;
+    } else {
+      int r = (static_cast<int>(u * n_) % n_) / 2;
+      for (int i = r; i < r + n_ / 2 && i < n_; ++i) {
+        fail_at_[i] = par_.fail_tick;
+      }
+    }
+  }
+  fail_at_.resize(n_, INT32_MAX);
+}
+
+bool Engine::Run(const std::string& outdir, bool quiet) {
+  LogSink log(outdir, /*bug_compat=*/true);
+  if (!log.ok()) return false;
+
+  // Construction-time output: one stdout line and one "APP" dbg.log line
+  // per node, forward order (Application.cpp:59-69,146).
+  for (int i = 0; i < n_; ++i) {
+    if (!quiet) {
+      printf("%d-th introduced node is assigned with the address: %d:0\n", i,
+             i + 1);
+    }
+    log.Event(i, 0, "APP");
+  }
+
+  for (int t = 0; t < par_.total_ticks; ++t) {
+    // Phase A — every started, live node drains its inbox
+    // (forward order, Application.cpp:125-135).  Messages are staged and
+    // handled in phase B, preserving the reference's recv-then-step split.
+    for (int i = 0; i < n_; ++i) {
+      if (failed_[i] || t <= start_at_[i]) continue;
+      bus_.Recv(i, t, [&](const uint8_t* data, size_t size) {
+        inbox_[i].emplace_back(data, data + size);
+      });
+    }
+
+    // Phase B — reverse order (Application.cpp:138-163): introduction at
+    // the start tick, else message handling + periodic ops.
+    for (int i = n_ - 1; i >= 0; --i) {
+      if (failed_[i]) continue;
+      if (t == start_at_[i]) {
+        NodeStart(log, i, t);
+      } else if (t > start_at_[i]) {
+        CheckMessages(log, i, t);
+        if (in_group_[i]) NodeLoopOps(log, i, t);
+        if (i == 0 && t % 500 == 0) {
+          char text[32];
+          snprintf(text, sizeof(text), "@@time=%d", t);
+          log.Event(0, t, text);  // Application.cpp:156-160
+        }
+      }
+    }
+
+    // Fault injection, after the protocol phases (Application.cpp:99-104).
+    // Note the single- and multi-failure log formats differ by spaces
+    // around '=' (Application.cpp:184,192).
+    for (int i = 0; i < n_; ++i) {
+      if (fail_at_[i] == t) {
+        char text[48];
+        snprintf(text, sizeof(text),
+                 par_.single_failure ? "Node failed at time=%d"
+                                     : "Node failed at time = %d",
+                 t);
+        log.Event(i, t, text);
+        failed_[i] = 1;
+      }
+    }
+  }
+
+  return bus_.Cleanup(outdir);
+}
+
+void Engine::NodeStart(LogSink& log, int i, int t) {
+  // introduceSelfToGroup (MP1Node.cpp:120-154): the introducer starts the
+  // group; everyone else sends a JOINREQ with its (empty) member list.
+  if (i == kIntroducer) {
+    log.Event(i, t, "Starting up group...");
+    in_group_[i] = 1;
+  } else {
+    log.Event(i, t, "Trying to join...");
+    // JOINREQ carries the joiner's (empty) member list (MP1Node.cpp:135-149).
+    std::vector<uint8_t> req;
+    wire_encode(&req, kJoinReq, i + 1, nullptr, 0);
+    bus_.Send(i, kIntroducer, req.data(), req.size(), t, par_.drop_active(t),
+              /*channel=*/1);
+  }
+}
+
+void Engine::CheckMessages(LogSink& log, int i, int t) {
+  // Process in ascending-sender order.  The bus queues phase-B sends in
+  // reverse node order (the driver steps nodes n-1..0), and the reference
+  // effectively delivers its buffer newest-first (reverse scan with
+  // swap-pop, EmulNet.cpp:151-160) — i.e. ascending sender id.  The
+  // order matters for exact heartbeat convergence: adopting the leader's
+  // piggybacked maximum *before* a later sender's direct increment is
+  // what makes every observer's value for a subject identical in steady
+  // state, which in turn makes failure-removal ticks uniform
+  // (all survivors at fail + TREMOVE + 1; BASELINE.md).
+  for (auto it = inbox_[i].rbegin(); it != inbox_[i].rend(); ++it) {
+    const auto& msg = *it;
+    WireHeader h;
+    const WireEntry* entries = nullptr;
+    if (!wire_decode(msg.data(), msg.size(), &h, &entries)) continue;
+    int s = h.sender - 1;
+    if (s < 0 || s >= n_ || s == i) continue;
+    switch (h.type) {
+      case kGossip:
+        HandleGossip(log, i, s, entries, h.count, t);
+        break;
+      case kJoinReq: {
+        // Introducer adds the requester (dedup'd) with heartbeat 1 and
+        // replies with its full member list (MP1Node.cpp:221-230).
+        if (!known_[cell(i, s)]) {
+          known_[cell(i, s)] = 1;
+          hb_[cell(i, s)] = 1;
+          ts_[cell(i, s)] = t;
+          log.NodeAdd(i, t, s);
+        }
+        std::vector<WireEntry> list;
+        for (int j = 0; j < n_; ++j) {
+          if (known_[cell(i, j)]) {
+            list.push_back({j + 1, hb_[cell(i, j)], ts_[cell(i, j)]});
+          }
+        }
+        std::vector<uint8_t> rep;
+        wire_encode(&rep, kJoinRep, i + 1, list.data(),
+                    static_cast<int32_t>(list.size()));
+        bus_.Send(i, s, rep.data(), rep.size(), t, par_.drop_active(t),
+                  /*channel=*/2);
+        break;
+      }
+      case kJoinRep:
+        // Joiner adds the sender (the introducer) and enters the group;
+        // the piggybacked list is ignored (MP1Node.cpp:231-233 — the
+        // joiner learns the rest of the group via subsequent gossip).
+        if (!known_[cell(i, s)]) {
+          known_[cell(i, s)] = 1;
+          hb_[cell(i, s)] = 1;
+          ts_[cell(i, s)] = t;
+          log.NodeAdd(i, t, s);
+        }
+        in_group_[i] = 1;
+        break;
+      default:
+        break;
+    }
+  }
+  inbox_[i].clear();
+}
+
+void Engine::HandleGossip(LogSink& log, int i, int s, const WireEntry* entries,
+                          int count, int t) {
+  // Direct-sender handling (MP1Node.cpp:236-242): a known sender's
+  // heartbeat is *incremented* locally (not adopted) and its timestamp
+  // refreshed; an unknown sender is added with heartbeat 1.
+  if (known_[cell(i, s)]) {
+    ++hb_[cell(i, s)];
+    ts_[cell(i, s)] = t;
+  } else {
+    known_[cell(i, s)] = 1;
+    hb_[cell(i, s)] = 1;
+    ts_[cell(i, s)] = t;
+    log.NodeAdd(i, t, s);
+  }
+  // Piggyback merge (MP1Node.cpp:244-256): adopt strictly larger
+  // heartbeats (stamping the local clock); add unknown entries whose
+  // timestamp is still fresh, copying the entry verbatim
+  // (addMember, MP1Node.cpp:282-301).  Any valid id merges — the
+  // reference's id<10 cap (MP1Node.cpp:245) is a bug, not a feature.
+  for (int k = 0; k < count; ++k) {
+    int j = entries[k].id - 1;
+    if (j < 0 || j >= n_ || j == i) continue;
+    if (known_[cell(i, j)]) {
+      if (entries[k].hb > hb_[cell(i, j)]) {
+        hb_[cell(i, j)] = entries[k].hb;
+        ts_[cell(i, j)] = t;
+      }
+    } else if (t - entries[k].ts < par_.t_remove) {
+      known_[cell(i, j)] = 1;
+      hb_[cell(i, j)] = entries[k].hb;
+      ts_[cell(i, j)] = entries[k].ts;
+      log.NodeAdd(i, t, j);
+    }
+  }
+}
+
+void Engine::NodeLoopOps(LogSink& log, int i, int t) {
+  // Own heartbeat (MP1Node.cpp:337), staleness sweep in reverse subject
+  // order (MP1Node.cpp:339-348), then full-list gossip to every member
+  // (MP1Node.cpp:350-361).
+  ++own_hb_[i];
+  for (int j = n_ - 1; j >= 0; --j) {
+    if (known_[cell(i, j)] && t - ts_[cell(i, j)] >= par_.t_remove) {
+      known_[cell(i, j)] = 0;
+      hb_[cell(i, j)] = 0;
+      ts_[cell(i, j)] = 0;
+      log.NodeRemove(i, t, j);
+    }
+  }
+  std::vector<WireEntry> list;
+  list.reserve(n_);
+  for (int j = 0; j < n_; ++j) {
+    if (known_[cell(i, j)]) {
+      list.push_back({j + 1, hb_[cell(i, j)], ts_[cell(i, j)]});
+    }
+  }
+  if (list.empty()) return;
+  std::vector<uint8_t> msg;
+  wire_encode(&msg, kGossip, i + 1, list.data(),
+              static_cast<int32_t>(list.size()));
+  bool window = par_.drop_active(t);
+  for (const auto& e : list) {
+    bus_.Send(i, e.id - 1, msg.data(), msg.size(), t, window, /*channel=*/0);
+  }
+}
+
+}  // namespace gossip
+
+// ---- C ABI -----------------------------------------------------------
+
+extern "C" {
+
+int gp_run_scenario(int n, int single_failure, int drop_msg, double drop_prob,
+                    int total_ticks, uint64_t seed, const int32_t* fail_ticks,
+                    const char* outdir) {
+  gossip::Params par;
+  par.max_nnb = n;
+  par.single_failure = single_failure != 0;
+  par.drop_msg = drop_msg != 0;
+  par.msg_drop_prob = drop_prob;
+  par.total_ticks = total_ticks;
+  par.seed = seed;
+  std::vector<int32_t> ft;
+  if (fail_ticks != nullptr) ft.assign(fail_ticks, fail_ticks + n);
+  gossip::Engine engine(par, std::move(ft));
+  return engine.Run(outdir != nullptr ? outdir : ".") ? 0 : 1;
+}
+
+int gp_run_conf(const char* conf_path, uint64_t seed, const char* outdir) {
+  gossip::Params par;
+  if (!par.LoadConf(conf_path != nullptr ? conf_path : "")) return 2;
+  par.seed = seed;
+  gossip::Engine engine(par);
+  return engine.Run(outdir != nullptr ? outdir : ".") ? 0 : 1;
+}
+
+}  // extern "C"

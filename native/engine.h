@@ -1,0 +1,83 @@
+// Native protocol engine: a vectorized struct-of-arrays implementation of
+// the gossip membership protocol over the EmulNet-shaped Bus.
+//
+// This is the framework's native CPU backend and differential oracle for
+// the JAX/TPU engine (gossip_protocol_tpu/core/tick.py).  It implements
+// the same protocol semantics the reference defines — join handshake
+// (JOINREQ/JOINREP, MP1Node.cpp:120-154,221-233), full-list heartbeat
+// gossip with max-merge (MP1Node.cpp:234-257,350-361), and TREMOVE
+// staleness removal (MP1Node.cpp:335-348) — but with a fresh design:
+// state is four dense arrays (known/hb/ts tables + per-node flags)
+// instead of N heap objects with vector<MemberListEntry> lists, messages
+// are really serialized (wire.h) instead of aliased pointers, and the
+// PRNG is counter-based and seedable instead of srand(time(NULL)).
+// The N<=10 merge cap (MP1Node.cpp:245, SURVEY.md §2.2 #2) is
+// deliberately NOT reproduced: any valid peer id merges.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "bus.h"
+#include "logsink.h"
+#include "params.h"
+#include "wire.h"
+
+namespace gossip {
+
+class Engine {
+ public:
+  // fail_ticks: per-node failure tick (INT32_MAX = never), or empty to
+  // derive the scenario schedule from params (single: one uniform victim;
+  // multi: contiguous half-block — Application.cpp:181-196 semantics,
+  // seeded PRNG instead of wall-clock rand()).
+  Engine(const Params& par, std::vector<int32_t> fail_ticks = {});
+
+  // Run the full scenario, writing dbg.log / stats.log / msgcount.log
+  // into outdir.  Returns false if the logs could not be opened.
+  bool Run(const std::string& outdir, bool quiet = true);
+
+  const std::vector<int32_t>& fail_ticks() const { return fail_at_; }
+  const std::vector<int32_t>& start_ticks() const { return start_at_; }
+
+ private:
+  void NodeStart(LogSink& log, int i, int t);
+  void CheckMessages(LogSink& log, int i, int t);
+  void NodeLoopOps(LogSink& log, int i, int t);
+  void HandleGossip(LogSink& log, int i, int sender, const WireEntry* entries,
+                    int count, int t);
+
+  // membership-table accessors (row-major [observer][subject])
+  size_t cell(int i, int j) const {
+    return static_cast<size_t>(i) * n_ + j;
+  }
+
+  Params par_;
+  int n_;
+  Bus bus_;
+  std::vector<int32_t> start_at_;  // introduction tick per node
+  std::vector<int32_t> fail_at_;   // failure tick per node (INT32_MAX = never)
+
+  // SoA world state — the native mirror of state.py's WorldState.
+  std::vector<uint8_t> failed_;    // [N]
+  std::vector<uint8_t> in_group_;  // [N]
+  std::vector<int64_t> own_hb_;    // [N]
+  std::vector<uint8_t> known_;     // [N*N]
+  std::vector<int64_t> hb_;        // [N*N]
+  std::vector<int64_t> ts_;        // [N*N]
+  std::vector<std::vector<std::vector<uint8_t>>> inbox_;  // staged per tick
+};
+
+}  // namespace gossip
+
+// ---- C ABI (ctypes surface) -----------------------------------------
+extern "C" {
+// Run one scenario natively.  fail_ticks may be NULL (derive from the
+// scenario parameters).  Returns 0 on success.
+int gp_run_scenario(int n, int single_failure, int drop_msg, double drop_prob,
+                    int total_ticks, uint64_t seed, const int32_t* fail_ticks,
+                    const char* outdir);
+// Same, parsing a reference-format .conf file. Returns 0 on success.
+int gp_run_conf(const char* conf_path, uint64_t seed, const char* outdir);
+}

@@ -1,0 +1,188 @@
+// Application: the native launcher binary.
+//
+// Drop-in replacement for the reference's `./Application <testcase.conf>`
+// entry point (Application.cpp:27-42): same argv contract, same output
+// files (dbg.log / stats.log / msgcount.log in the working directory),
+// so the reference's Grader.sh and testcases/*.conf run unmodified
+// against this framework.
+//
+// Two backends:
+//   * jax (default)  — embeds CPython and delegates the whole run to the
+//     TPU-native engine (gossip_protocol_tpu.cli.main): the simulation is
+//     a jitted lax.scan over batched device tensors.  The launcher sets
+//     conservative env defaults (platform, compilation cache) before the
+//     interpreter boots.
+//   * native         — the in-process C++ engine (engine.cc): no Python,
+//     sub-second at N=10; also the differential oracle.
+//
+// Select with `--backend={jax,native}` or GOSSIP_BACKEND=... (flag wins).
+// Extra args after the conf file are forwarded to the Python CLI.
+
+#include <Python.h>
+
+#include <climits>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "engine.h"
+#include "params.h"
+
+namespace {
+
+void SetDefaultEnv(const char* key, const char* value) {
+  if (getenv(key) == nullptr) setenv(key, value, 0);
+}
+
+std::string DirName(const std::string& path) {
+  auto slash = path.find_last_of('/');
+  return slash == std::string::npos ? std::string(".") : path.substr(0, slash);
+}
+
+bool Exists(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "r");
+  if (f == nullptr) return false;
+  fclose(f);
+  return true;
+}
+
+// The interpreter the user's environment would run as `python3` — venvs
+// included.  Embedding with this as config.executable makes the path
+// machinery honor pyvenv.cfg, so site-packages (jax et al.) resolve.
+std::string FindPython() {
+  const char* explicit_py = getenv("GOSSIP_PYTHON");
+  if (explicit_py != nullptr && Exists(explicit_py)) return explicit_py;
+  const char* venv = getenv("VIRTUAL_ENV");
+  if (venv != nullptr) {
+    std::string p = std::string(venv) + "/bin/python3";
+    if (Exists(p)) return p;
+  }
+  const char* path = getenv("PATH");
+  if (path != nullptr) {
+    std::string paths = path;
+    size_t start = 0;
+    while (start <= paths.size()) {
+      size_t end = paths.find(':', start);
+      if (end == std::string::npos) end = paths.size();
+      std::string p = paths.substr(start, end - start) + "/python3";
+      if (Exists(p)) return p;
+      start = end + 1;
+    }
+  }
+  return "";
+}
+
+int RunNative(const std::string& conf, uint64_t seed) {
+  gossip::Params par;
+  if (!par.LoadConf(conf)) {
+    fprintf(stderr, "Application: cannot read config %s\n", conf.c_str());
+    return 2;
+  }
+  par.seed = seed;
+  gossip::Engine engine(par);
+  return engine.Run(".", /*quiet=*/false) ? 0 : 1;
+}
+
+// Embed CPython and call gossip_protocol_tpu.cli.main(argv_tail).
+int RunJax(const std::string& self_path,
+           const std::vector<std::string>& cli_args) {
+  // The TPU in this image sits behind a single-grant tunnel that can
+  // stall unrelated processes; the N<=1000 compat path is CPU-bound
+  // anyway.  Opt into an accelerator explicitly with GOSSIP_TPU_PLATFORM.
+  const char* plat = getenv("GOSSIP_TPU_PLATFORM");
+  SetDefaultEnv("JAX_PLATFORMS", plat != nullptr ? plat : "cpu");
+  // Persistent compilation cache: repeat grader invocations of the same
+  // scenario shape skip XLA recompilation.
+  SetDefaultEnv("JAX_COMPILATION_CACHE_DIR", "/tmp/gossip_tpu_xla_cache");
+
+  PyConfig config;
+  PyConfig_InitPythonConfig(&config);
+  PyStatus status = PyConfig_SetBytesString(&config, &config.program_name,
+                                            self_path.c_str());
+  if (PyStatus_Exception(status)) return 3;
+  std::string py = FindPython();
+  if (!py.empty()) {
+    status = PyConfig_SetBytesString(&config, &config.executable, py.c_str());
+    if (PyStatus_Exception(status)) return 3;
+  }
+  status = Py_InitializeFromConfig(&config);
+  PyConfig_Clear(&config);
+  if (PyStatus_Exception(status)) return 3;
+
+  int rc = 3;
+  // The package lives next to the binary (repo root).
+  std::string repo_root = DirName(self_path);
+  PyObject* sys_path = PySys_GetObject("path");  // borrowed
+  PyObject* root = PyUnicode_FromString(repo_root.c_str());
+  if (sys_path != nullptr && root != nullptr) {
+    PyList_Insert(sys_path, 0, root);
+  }
+  Py_XDECREF(root);
+
+  PyObject* mod = PyImport_ImportModule("gossip_protocol_tpu.cli");
+  if (mod != nullptr) {
+    PyObject* argv = PyList_New(0);
+    for (const auto& a : cli_args) {
+      PyObject* s = PyUnicode_FromString(a.c_str());
+      PyList_Append(argv, s);
+      Py_XDECREF(s);
+    }
+    PyObject* result = PyObject_CallMethod(mod, "main", "(O)", argv);
+    if (result != nullptr) {
+      rc = static_cast<int>(PyLong_AsLong(result));
+      Py_DECREF(result);
+    }
+    Py_DECREF(argv);
+    Py_DECREF(mod);
+  }
+  if (PyErr_Occurred()) {
+    PyErr_Print();
+    rc = 3;
+  }
+  Py_Finalize();
+  return rc;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string conf;
+  std::string backend = getenv("GOSSIP_BACKEND") != nullptr
+                            ? getenv("GOSSIP_BACKEND")
+                            : "jax";
+  uint64_t seed = 0;
+  std::vector<std::string> passthrough;
+
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    if (arg.rfind("--backend=", 0) == 0) {
+      backend = arg.substr(strlen("--backend="));
+    } else if (arg.rfind("--seed=", 0) == 0) {
+      seed = strtoull(arg.c_str() + strlen("--seed="), nullptr, 10);
+      passthrough.push_back("--seed");
+      passthrough.push_back(arg.substr(strlen("--seed=")));
+    } else if (conf.empty() && arg[0] != '-') {
+      conf = arg;
+    } else {
+      passthrough.push_back(arg);
+    }
+  }
+  if (conf.empty()) {
+    // Same usage contract as the reference (Application.cpp:34-38).
+    fprintf(stderr, "Configuration (i.e., *.conf) file is required\n");
+    fprintf(stderr,
+            "usage: %s <conf> [--backend=jax|native] [--seed=N] "
+            "[python-cli args...]\n",
+            argc > 0 ? argv[0] : "Application");
+    return 2;
+  }
+
+  if (backend == "native") return RunNative(conf, seed);
+
+  std::vector<std::string> cli_args;
+  cli_args.push_back(conf);
+  for (const auto& a : passthrough) cli_args.push_back(a);
+  return RunJax(argv[0], cli_args);
+}

@@ -1,0 +1,99 @@
+#include "logsink.h"
+
+#include <cstring>
+
+namespace gossip {
+namespace {
+
+constexpr const char kMagic[] = "CS425";  // Log.h:19
+
+int MagicSum() {
+  int s = 0;
+  for (const char* p = kMagic; *p; ++p) s += static_cast<unsigned char>(*p);
+  return s;
+}
+
+std::string Join(const std::string& dir, const char* name) {
+  if (dir.empty() || dir == ".") return name;
+  return dir + "/" + name;
+}
+
+}  // namespace
+
+const char* AddrStr(int index, char* buf, size_t bufsz, int port) {
+  uint32_t id = static_cast<uint32_t>(index + 1);
+  snprintf(buf, bufsz, "%u.%u.%u.%u:%d", id & 0xFF, (id >> 8) & 0xFF,
+           (id >> 16) & 0xFF, (id >> 24) & 0xFF, port);
+  return buf;
+}
+
+LogSink::LogSink(const std::string& outdir, bool bug_compat)
+    : bug_compat_(bug_compat) {
+  dbg_ = fopen(Join(outdir, "dbg.log").c_str(), "w");
+  if (dbg_ != nullptr) {
+    fprintf(dbg_, "%x\n", MagicSum());  // Log.cpp:80-88
+  }
+  FILE* stats = fopen(Join(outdir, "stats.log").c_str(), "w");
+  if (stats != nullptr) fclose(stats);
+}
+
+LogSink::~LogSink() {
+  if (dbg_ != nullptr) fclose(dbg_);
+}
+
+void LogSink::Event(int observer, int tick, const char* text) {
+  if (dbg_ == nullptr) return;
+  char addr[32];
+  bool blank = observer < 0 || (first_ && bug_compat_);
+  first_ = false;
+  if (blank) {
+    fprintf(dbg_, "\n [%d] %s", tick, text);
+  } else {
+    fprintf(dbg_, "\n %s [%d] %s", AddrStr(observer, addr, sizeof(addr), 0),
+            tick, text);
+  }
+}
+
+void LogSink::NodeAdd(int observer, int tick, int subject) {
+  char addr[32], text[64];
+  snprintf(text, sizeof(text), "Node %s joined at time %d",
+           AddrStr(subject, addr, sizeof(addr), 0), tick);
+  Event(observer, tick, text);
+}
+
+void LogSink::NodeRemove(int observer, int tick, int subject) {
+  char addr[32], text[64];
+  snprintf(text, sizeof(text), "Node %s removed at time %d",
+           AddrStr(subject, addr, sizeof(addr), 0), tick);
+  Event(observer, tick, text);
+}
+
+bool WriteMsgCount(const std::string& outdir, const uint32_t* sent,
+                   const uint32_t* recv, int n, int t_total) {
+  FILE* f = fopen(Join(outdir, "msgcount.log").c_str(), "w");
+  if (f == nullptr) return false;
+  for (int i = 0; i < n; ++i) {
+    int node_id = i + 1;
+    fprintf(f, "node %3d ", node_id);
+    uint64_t stot = 0, rtot = 0;
+    for (int j = 0; j < t_total; ++j) {
+      uint32_t s = sent[static_cast<size_t>(i) * t_total + j];
+      uint32_t r = recv[static_cast<size_t>(i) * t_total + j];
+      stot += s;
+      rtot += r;
+      if (node_id != 67) {  // the EmulNet.cpp:204 oddity, kept verbatim
+        fprintf(f, " (%4u, %4u)", s, r);
+        if (j % 10 == 9) fprintf(f, "\n         ");
+      } else {
+        fprintf(f, "special %4d %4u %4u\n", j, s, r);
+      }
+    }
+    fprintf(f, "\nnode %3d sent_total %6llu  recv_total %6llu\n\n", node_id,
+            static_cast<unsigned long long>(stot),
+            static_cast<unsigned long long>(rtot));
+  }
+  fclose(f);
+  return true;
+}
+
+}  // namespace gossip

@@ -1,0 +1,57 @@
+// Observability sink: the reference-grammar log files, written natively.
+//
+// Native twin of gossip_protocol_tpu/logging_compat.py (the single source
+// of truth for the grammar).  Three files:
+//
+//  * dbg.log    — the grep-able event log Grader.sh asserts on.  First
+//    line is the hex char-sum of "CS425" (= "131", reference Log.cpp:79-88);
+//    each event renders as "\n <addr> [tick] <text>" (Log.cpp:97-99).
+//    Under bug_compat the very first event's address is blank, matching
+//    the reference's uninitialized static buffer on the first LOG call
+//    (Log.cpp:56-73).
+//  * stats.log  — created empty (no #STATSLOG# producers, Log.cpp:90-95).
+//  * msgcount.log — per-node/per-tick (sent, recv) matrix in ENcleanup's
+//    format (EmulNet.cpp:184-220), including the 10-per-line wrapping and
+//    the node-67 "special" rows.
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <string>
+
+namespace gossip {
+
+// Dotted log form of peer index (0-based): little-endian bytes of
+// id = index + 1, then ":port" (Log.cpp:73).  Writes into buf, returns buf.
+const char* AddrStr(int index, char* buf, size_t bufsz, int port = 0);
+
+class LogSink {
+ public:
+  // Opens outdir/dbg.log (writing the magic first line) and creates an
+  // empty outdir/stats.log alongside it (Log.cpp:66-67).
+  LogSink(const std::string& outdir, bool bug_compat = true);
+  ~LogSink();
+
+  // One event line.  observer < 0 renders a blank address
+  // unconditionally; otherwise the first call renders blank iff
+  // bug_compat (the Log.cpp:56-73 quirk).
+  void Event(int observer, int tick, const char* text);
+
+  // printf-style convenience for the standard event texts.
+  void NodeAdd(int observer, int tick, int subject);     // Log.cpp:116-120
+  void NodeRemove(int observer, int tick, int subject);  // Log.cpp:127-131
+
+  bool ok() const { return dbg_ != nullptr; }
+
+ private:
+  FILE* dbg_ = nullptr;
+  bool first_ = true;
+  bool bug_compat_;
+};
+
+// Write outdir/msgcount.log from (n, t_total) row-major counters.
+// Node ids print 1-based; see EmulNet.cpp:195-216 for the format quirks.
+bool WriteMsgCount(const std::string& outdir, const uint32_t* sent,
+                   const uint32_t* recv, int n, int t_total);
+
+}  // namespace gossip

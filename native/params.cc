@@ -1,0 +1,41 @@
+#include "params.h"
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+
+namespace gossip {
+
+bool Params::LoadConf(const std::string& path) {
+  std::ifstream in(path);
+  if (!in) return false;
+  std::string line;
+  while (std::getline(in, line)) {
+    auto colon = line.find(':');
+    if (colon == std::string::npos) continue;
+    std::string key = line.substr(0, colon);
+    std::string val = line.substr(colon + 1);
+    auto strip = [](std::string* s) {
+      size_t a = s->find_first_not_of(" \t\r\n");
+      size_t b = s->find_last_not_of(" \t\r\n");
+      *s = (a == std::string::npos) ? "" : s->substr(a, b - a + 1);
+    };
+    strip(&key);
+    strip(&val);
+    if (val.empty()) continue;
+    if (key == "MAX_NNB") {
+      max_nnb = std::atoi(val.c_str());
+    } else if (key == "SINGLE_FAILURE") {
+      single_failure = std::atoi(val.c_str()) != 0;
+    } else if (key == "DROP_MSG") {
+      drop_msg = std::atoi(val.c_str()) != 0;
+    } else if (key == "MSG_DROP_PROB") {
+      msg_drop_prob = std::atof(val.c_str());
+    }
+  }
+  return true;
+}
+
+}  // namespace gossip

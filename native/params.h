@@ -1,0 +1,51 @@
+// Scenario configuration: native twin of gossip_protocol_tpu/config.py.
+//
+// Parses the reference's 4-line `KEY: value` .conf grammar (reference
+// Params.cpp:22-25) and carries the same derived constants the reference
+// hardwires at compile time (TOTAL_RUNNING_TIME Application.h:27, TREMOVE
+// MP1Node.h:21, buffer limits EmulNet.h:10-12, STEP_RATE/MAX_MSG_SIZE
+// Params.cpp:30-31).  Unlike the reference's positional fscanf, keys may
+// appear in any order and unknown keys are ignored.
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+namespace gossip {
+
+struct Params {
+  // .conf fields (Params.cpp:22-25)
+  int max_nnb = 10;            // MAX_NNB; EN_GPSZ = MAX_NNB (Params.cpp:29)
+  bool single_failure = true;  // SINGLE_FAILURE
+  bool drop_msg = false;       // DROP_MSG
+  double msg_drop_prob = 0.1;  // MSG_DROP_PROB
+
+  // reference compile-time constants, same defaults
+  int total_ticks = 700;    // TOTAL_RUNNING_TIME (Application.h:27)
+  double step_rate = 0.25;  // STEP_RATE (Params.cpp:30)
+  int t_remove = 20;        // TREMOVE (MP1Node.h:21)
+  int fail_tick = 100;      // failure injection time (Application.cpp:181,188)
+  int drop_open_tick = 50;  // drop window opens after this tick (Application.cpp:177)
+  int drop_close_tick = 300;  // ...and closes after this one (Application.cpp:198)
+  int max_msg_size = 4000;  // MAX_MSG_SIZE (Params.cpp:31)
+  int en_buff_size = 30000;  // ENBUFFSIZE (EmulNet.h:12)
+
+  // framework knob (the reference seeds srand(time(NULL)), Application.cpp:50)
+  uint64_t seed = 0;
+
+  int n() const { return max_nnb; }
+  // Node i is introduced at tick int(step_rate * i) — C float-to-int
+  // truncation (Application.cpp:143).
+  int start_tick(int i) const { return static_cast<int>(step_rate * i); }
+  // The dropmsg window is open for sends during ticks (open, close]
+  // (flag set after tick 50, cleared after tick 300,
+  // Application.cpp:177-179,198-200).
+  bool drop_active(int t) const {
+    return drop_msg && t > drop_open_tick && t <= drop_close_tick;
+  }
+
+  // Parse a .conf file; returns false if the file cannot be read.
+  bool LoadConf(const std::string& path);
+};
+
+}  // namespace gossip

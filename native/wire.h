@@ -1,0 +1,70 @@
+// Dense wire format for protocol messages.
+//
+// The reference has no real serialization: it memcpys a C++ struct whose
+// fields are a raw Address* into the *sender's* heap and a std::vector
+// header aliasing sender-owned storage (reference MP1Node.cpp:136-147,
+// EmulNet.cpp:96-101) — receivers dereference foreign pointers, which only
+// works because all emulated peers share one address space.  This framework
+// fixes that quirk (SURVEY.md §2.2 #1) with a trivially-copyable,
+// position-independent, fixed-width layout: a message is a header followed
+// by `count` packed entries.  The same bytes are valid across processes,
+// over ctypes into Python, and as rows of a device tensor.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace gossip {
+
+enum MsgType : int32_t {
+  // Same protocol vocabulary as the reference (MP1Node.h:31-36); the
+  // DUMMYLASTMSGTYPE sentinel is dropped.
+  kJoinReq = 0,
+  kJoinRep = 1,
+  kGossip = 2,
+};
+
+#pragma pack(push, 1)
+struct WireHeader {
+  int32_t type;    // MsgType
+  int32_t sender;  // peer id (1-based, EmulNet.cpp:74 numbering)
+  int32_t count;   // number of WireEntry records that follow
+};
+
+struct WireEntry {
+  // One membership-table cell (MemberListEntry, reference Member.h:62-81).
+  int32_t id;  // peer id (1-based)
+  int64_t hb;  // heartbeat
+  int64_t ts;  // local-clock timestamp at the sender
+};
+#pragma pack(pop)
+
+inline size_t wire_size(int32_t count) {
+  return sizeof(WireHeader) + static_cast<size_t>(count) * sizeof(WireEntry);
+}
+
+// Serialize into `out` (resized to fit).  Entries are appended verbatim.
+inline void wire_encode(std::vector<uint8_t>* out, int32_t type, int32_t sender,
+                        const WireEntry* entries, int32_t count) {
+  out->resize(wire_size(count));
+  WireHeader h{type, sender, count};
+  std::memcpy(out->data(), &h, sizeof(h));
+  if (count > 0) {
+    std::memcpy(out->data() + sizeof(h), entries,
+                static_cast<size_t>(count) * sizeof(WireEntry));
+  }
+}
+
+// Validate and view a received buffer.  Returns false on malformed input
+// (short buffer / negative count) — a real check the reference cannot do.
+inline bool wire_decode(const uint8_t* data, size_t size, WireHeader* h,
+                        const WireEntry** entries) {
+  if (size < sizeof(WireHeader)) return false;
+  std::memcpy(h, data, sizeof(WireHeader));
+  if (h->count < 0 || wire_size(h->count) > size) return false;
+  *entries = reinterpret_cast<const WireEntry*>(data + sizeof(WireHeader));
+  return true;
+}
+
+}  // namespace gossip

@@ -47,8 +47,17 @@ def test_full_parity(scenario, seed):
     # final tables
     km = o.known_matrix()
     assert np.array_equal(km, np.asarray(res.final_state.known))
-    assert np.array_equal(o.table("ts"),
-                          np.asarray(res.final_state.ts) * km)
+    ts_diff = o.table("ts") - np.asarray(res.final_state.ts) * km
+    if not cfg.drop_msg:
+        assert not ts_diff.any()
+    else:
+        # Failed nodes freeze their table at the fail tick; the +/-1
+        # heartbeat transient can shift one last merge-refresh by a tick
+        # under drop, and the frozen row preserves it.  Live rows still
+        # converge exactly.
+        frozen = (np.asarray(res.fail_tick) <= cfg.total_ticks)[:, None]
+        assert not (ts_diff * ~frozen).any()
+        assert np.abs(ts_diff).max() <= 1
     hb_diff = o.table("hb") - np.asarray(res.final_state.hb) * km
     assert np.abs(hb_diff).max() <= 1
 
