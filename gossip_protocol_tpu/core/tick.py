@@ -166,8 +166,14 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None):
         known_after_adds = known
 
         # ---- nodeStart: staggered introduction ---------------------
-        # (Application.cpp:143-148; MP1Node.cpp:120-154)
-        starting = (t == sched.start_tick) & ~failed
+        # (Application.cpp:143-148; MP1Node.cpp:120-154).  The driver's
+        # introduction branch does NOT check bFailed (only recvLoop and
+        # nodeLoop do, Application.cpp:130,153), so a peer whose start
+        # tick falls after its fail tick still sends its JOINREQ: the
+        # introducer admits it, gossips its (forever-silent) entry, and
+        # everyone removes it TREMOVE ticks later.  Reachable whenever
+        # start_tick > fail_tick, i.e. N > 404 with the stock schedule.
+        starting = t == sched.start_tick
         in_group = in_group | (starting & intro_onehot)  # "Starting up group..."
         joinreq_new = starting & ~intro_onehot           # JOINREQ send
 

@@ -168,7 +168,14 @@ def main(argv=None) -> int:
                                  "(Grader.sh-equivalent checks)")
     ap.add_argument("--testcases", default="testcases")
     ap.add_argument("--workdir", default=".")
+    ap.add_argument("--platform", default="cpu",
+                    help="jax platform for the N=10 grading runs (default "
+                         "cpu: grading is tiny and must not dial an "
+                         "accelerator tunnel)")
     args = ap.parse_args(argv)
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
     results = grade_all(_default_runner, args.testcases, args.workdir)
     for name, g in results.items():
         if isinstance(g, ScenarioGrade):

@@ -76,11 +76,16 @@ bool Engine::Run(const std::string& outdir, bool quiet) {
     }
 
     // Phase B — reverse order (Application.cpp:138-163): introduction at
-    // the start tick, else message handling + periodic ops.
+    // the start tick, else message handling + periodic ops.  The
+    // introduction branch is NOT gated on bFailed (Application.cpp:142-147
+    // checks it only for the nodeLoop else-branch), so a peer whose start
+    // tick falls after its fail tick still sends its JOINREQ and is
+    // admitted — then removed TREMOVE ticks later, never having gossiped.
     for (int i = n_ - 1; i >= 0; --i) {
-      if (failed_[i]) continue;
       if (t == start_at_[i]) {
         NodeStart(log, i, t);
+      } else if (failed_[i]) {
+        continue;
       } else if (t > start_at_[i]) {
         CheckMessages(log, i, t);
         if (in_group_[i]) NodeLoopOps(log, i, t);

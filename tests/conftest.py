@@ -34,6 +34,12 @@ TESTCASES = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file_
                          "testcases")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: bench-scale validation runs (deselect with "
+        "-m 'not slow' while iterating)")
+
+
 @pytest.fixture(scope="session")
 def testcases_dir():
     return TESTCASES

@@ -162,6 +162,20 @@ def _jax_events(cfg, fail_ticks):
     return np.asarray(ev.added), np.asarray(ev.removed)
 
 
+def _parse_native_events(dbg_path):
+    """dbg.log -> ({(observer, subject, tick)} joins, {...} removals)."""
+    import re
+    adds, rems = set(), set()
+    for ln in dbg_path.read_text().splitlines():
+        m = re.match(r" (\d+)\.0\.0\.0:0 \[(\d+)\] Node (\d+)\.0\.0\.0:0 "
+                     r"(joined|removed)", ln)
+        if m:
+            obs, t, subj, kind = (int(m.group(1)) - 1, int(m.group(2)),
+                                  int(m.group(3)) - 1, m.group(4))
+            (adds if kind == "joined" else rems).add((obs, subj, t))
+    return adds, rems
+
+
 @pytest.mark.parametrize("single", [True, False])
 def test_native_vs_jax_event_parity(lib, tmp_path, single):
     """With an identical (pinned) failure schedule and no message drops,
@@ -182,17 +196,7 @@ def test_native_vs_jax_event_parity(lib, tmp_path, single):
     rc = native.run_scenario(n, single, False, 0.0, t_total, seed=0,
                              fail_ticks=fail, outdir=str(tmp_path))
     assert rc == 0
-
-    import re
-    adds_native, rems_native = set(), set()
-    for ln in (tmp_path / "dbg.log").read_text().splitlines():
-        m = re.match(r" (\d+)\.0\.0\.0:0 \[(\d+)\] Node (\d+)\.0\.0\.0:0 "
-                     r"(joined|removed)", ln)
-        if m:
-            obs, t, subj, kind = (int(m.group(1)) - 1, int(m.group(2)),
-                                  int(m.group(3)) - 1, m.group(4))
-            (adds_native if kind == "joined" else rems_native).add(
-                (obs, subj, t))
+    adds_native, rems_native = _parse_native_events(tmp_path / "dbg.log")
 
     # the JAX event masks are (t, observer, subject)
     added, removed = _jax_events(cfg, fail)
@@ -201,6 +205,37 @@ def test_native_vs_jax_event_parity(lib, tmp_path, single):
 
     assert adds_native == adds_jax
     assert rems_native == rems_jax
+
+
+def test_native_vs_jax_start_after_fail_parity(lib, tmp_path):
+    """Peers whose start tick falls after their (pinned, early) fail tick
+    are still introduced — the reference's introduction branch does not
+    check bFailed (Application.cpp:142-147) — and both engines must emit
+    the identical posthumous join/removal events for them."""
+    from gossip_protocol_tpu.config import SimConfig
+
+    n, t_total = 24, 80
+    cfg = SimConfig(max_nnb=n, single_failure=False, drop_msg=False,
+                    seed=0, total_ticks=t_total)
+    fail = np.full(n, np.iinfo(np.int32).max, np.int32)
+    fail[16:24] = 3            # starts are int(0.25*i) in [4, 5] > 3
+
+    rc = native.run_scenario(n, False, False, 0.0, t_total, seed=0,
+                             fail_ticks=fail, outdir=str(tmp_path))
+    assert rc == 0
+    adds_native, rems_native = _parse_native_events(tmp_path / "dbg.log")
+
+    added, removed = _jax_events(cfg, fail)
+    adds_jax = {(int(i), int(j), int(t)) for t, i, j in zip(*np.nonzero(added))}
+    rems_jax = {(int(i), int(j), int(t)) for t, i, j in zip(*np.nonzero(removed))}
+    assert adds_native == adds_jax
+    assert rems_native == rems_jax
+    # the posthumous members were admitted by the introducer and removed
+    # TREMOVE + 1 ticks after their start by every live peer
+    for j in range(16, 24):
+        s = int(0.25 * j)
+        assert (0, j, s + 1) in adds_native
+        assert (0, j, s + cfg.t_remove + 1) in rems_native
 
 
 def test_hash_uniform_python_native_parity(lib):

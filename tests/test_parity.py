@@ -12,6 +12,7 @@ documented canonical-order effect, core/tick.py docstring).
 import numpy as np
 import pytest
 
+from gossip_protocol_tpu.config import SimConfig
 from gossip_protocol_tpu.core.sim import Simulation
 from gossip_protocol_tpu.state import make_schedule
 from gossip_protocol_tpu.testing.dropsync import make_drop_masks
@@ -114,6 +115,103 @@ def test_determinism_and_seed_sensitivity():
     assert np.array_equal(r1.sent, r2.sent)
     r3 = Simulation(scenario_cfg("msgdropsinglefailure", seed=12)).run()
     assert not np.array_equal(r1.sent, r3.sent)
+
+
+@pytest.mark.parametrize("single", [True, False])
+def test_start_after_fail_parity(single):
+    """A peer whose start tick falls after its fail tick still sends its
+    JOINREQ — the driver's introduction branch does not check bFailed
+    (Application.cpp:142-147; only recvLoop/nodeLoop do).  The
+    introducer admits the silent peer and everyone removes it TREMOVE
+    ticks later.  Exercised here with an early fail tick so
+    start_tick > fail_tick is reachable at small N; full exact parity
+    against the message-level oracle."""
+    seed = 1 if single else 0   # victim 19 (start 4) / block [5, 17)
+    cfg = scenario_cfg("singlefailure" if single else "multifailure",
+                       max_nnb=24, fail_tick=3, total_ticks=80, seed=seed)
+    res = Simulation(cfg).run()
+    start = res.start_tick
+    fail = res.fail_tick
+    late = (start > fail) & (fail <= cfg.total_ticks)
+    assert late.any(), "schedule must exercise start_tick > fail_tick"
+
+    o = ReferenceOracle(cfg, start, fail).run()
+    gv = res.grader_view()
+    assert {(i, j) for (_, i, j) in o.events.added} == gv["joins"]
+    oracle_removals = {}
+    for (t, i, j) in o.events.removed:
+        oracle_removals.setdefault((i, j), t)
+    assert oracle_removals == gv["removal_ticks"]
+    assert np.array_equal(o.known_matrix(), np.asarray(res.final_state.known))
+    assert np.array_equal(o.sent, res.sent)
+    assert np.array_equal(o.recv, res.recv)
+    # the late-started victims were admitted (introducer logged a join)
+    # and then removed TREMOVE+1 ticks after their start
+    for j in np.flatnonzero(late):
+        assert (0, j) in gv["joins"]
+        assert gv["removal_ticks"][(0, j)] == start[j] + cfg.t_remove + 1
+
+
+@pytest.mark.slow
+def test_bench_scale_invariants():
+    """Grader-style validation of the benchmarked N=512 configuration
+    (multifailure block covering late starters; no drop so the checks
+    are exact).  The reference cannot run this shape at all (N<=10
+    merge cap MP1Node.cpp:245, 30k-message buffer EmulNet.h:12)."""
+    cfg = SimConfig(max_nnb=512, single_failure=False, drop_msg=False,
+                    seed=1, total_ticks=160)
+    res = Simulation(cfg).run()
+    gv = res.grader_view()
+    start = res.start_tick
+    failed = gv["failed"]
+    assert len(failed) == 256
+    late_victims = {j for j in failed if start[j] > cfg.fail_tick}
+    assert late_victims, "seed must place the failure block over late starters"
+
+    # no false positives: every removal names a failed peer
+    assert {subj for (_, subj) in gv["removal_ticks"]} <= failed
+
+    # early-started live observers see every other peer join, including
+    # the late-started victims the introducer admits posthumously
+    early_live = [i for i in range(cfg.n)
+                  if i not in failed and start[i] <= 79]
+    for i in early_live[:: max(1, len(early_live) // 16)]:
+        assert {j for (obs, j) in gv["joins"] if obs == i} \
+            == set(range(cfg.n)) - {i}
+
+    removals_by_subject = {}
+    for (obs, subj), t in gv["removal_ticks"].items():
+        removals_by_subject.setdefault(subj, {})[obs] = t
+    t_det = cfg.fail_tick + cfg.t_remove + 1
+    for j in failed:
+        by_obs = removals_by_subject[j]
+        if j in late_victims:
+            # silent posthumous member: entry ts is pinned at its
+            # introduction tick, so every observer removes at
+            # start + TREMOVE + 1 exactly
+            assert set(by_obs.values()) == {start[j] + cfg.t_remove + 1}, j
+        elif start[j] <= cfg.fail_tick - 4:
+            # fully-active victim: joined, learned the full membership,
+            # and gossiped to everyone through the fail tick.  Observers
+            # started before the failure refresh its timestamp from its
+            # final gossip and detect at exactly fail + TREMOVE + 1 =
+            # 121; observers that joined after the failure hold a
+            # one-tick-older piggybacked copy.
+            for obs, t in by_obs.items():
+                if start[obs] <= cfg.fail_tick:
+                    assert t == t_det, (obs, j, t)
+                else:
+                    assert t_det - 1 <= t <= t_det + 1, (obs, j, t)
+        else:
+            # boundary victim (started within the JOINREQ/JOINREP
+            # round-trip of the fail tick): it may have gossiped zero or
+            # a few times before failing, so per-observer timestamps
+            # span its introduction tick through its last relayed
+            # refresh — detection lands within a small window
+            for t in by_obs.values():
+                assert start[j] + cfg.t_remove <= t <= t_det + 2, (j, t)
+        # every early-started live observer detects every victim
+        assert set(early_live) <= set(by_obs), j
 
 
 def test_scales_past_reference_cap():
