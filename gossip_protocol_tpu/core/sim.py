@@ -70,9 +70,11 @@ class Simulation:
     """Compile once per (config shape), run many times."""
 
     def __init__(self, cfg: SimConfig, block_size: int = 128,
-                 chunk_ticks: Optional[int] = None):
+                 chunk_ticks: Optional[int] = None,
+                 use_pallas: Optional[bool] = None):
         self.cfg = cfg
         self.block_size = block_size
+        self.use_pallas = use_pallas
         # Default chunking keeps staged event masks under ~256 MB.
         if chunk_ticks is None:
             per_tick = 2 * cfg.n * cfg.n  # two bool masks
@@ -85,7 +87,8 @@ class Simulation:
         if length not in self._trace_runs:
             cfg = self.cfg.replace(total_ticks=length)
             self._trace_runs[length] = make_run(cfg, self.block_size,
-                                                with_events=True)
+                                                with_events=True,
+                                                use_pallas=self.use_pallas)
         return self._trace_runs[length]
 
     def run(self, seed: Optional[int] = None) -> SimResult:
@@ -124,7 +127,9 @@ class Simulation:
         cfg = self.cfg if seed is None else self.cfg.replace(seed=seed)
         sched = make_schedule(cfg)
         if self._bench_run is None:
-            self._bench_run = make_run(cfg, self.block_size, with_events=False)
+            self._bench_run = make_run(cfg, self.block_size,
+                                       with_events=False,
+                                       use_pallas=self.use_pallas)
         run = self._bench_run
         if warmup:  # compile outside the timed region
             s, e = run(init_state(cfg), sched)
@@ -133,6 +138,12 @@ class Simulation:
         t0 = time.perf_counter()
         state, ev = run(state, sched)
         jax.block_until_ready(state)
+        # Force a device->host readback inside the timed region: on
+        # relayed/tunneled accelerators block_until_ready can return on
+        # dispatch acknowledgement, and a wall-clock without a data
+        # dependency under-reports.  (Not an assert: must survive -O.)
+        if int(np.asarray(state.tick)) != cfg.total_ticks:
+            raise RuntimeError("bench run did not complete all ticks")
         wall = time.perf_counter() - t0
         return SimResult(
             cfg=cfg,

@@ -21,8 +21,12 @@ queued messages in EmulNet buffer order; we apply a canonical order
 (all piggyback merges, then all direct-sender updates, then join
 messages — matching the observed queue order gossip-before-JOINREP /
 gossip-before-JOINREQ, EmulNet.cpp:151-160).  The only reachable
-difference is a transient +/-1 on a heartbeat counter during the join
-phase, which is not observable in any logged event or removal time
+difference is a small offset on heartbeat counters seeded during the
+join phase: an entry created one merge-order step apart ends up +/-1,
+and because later merges adopt only strictly larger values the offset
+persists, and two independently-seeded offsets can stack along a
+gossip path (observed max 2, drop scenarios only).  It is not
+observable in any logged event, removal time, or live-row timestamp
 (asserted by tests/test_parity.py against the message-level oracle).
 
 Fault injection runs *after* the protocol phases (Application.cpp:99-104),
@@ -47,6 +51,7 @@ from flax import struct
 
 from ..config import INTRODUCER, SimConfig
 from ..ops.detect import staleness_mask
+from ..ops.drop import tick_drop_masks
 from ..parallel.comm import LocalComm
 from ..state import Schedule, WorldState
 
@@ -67,23 +72,18 @@ class TickEvents:
     recv: jax.Array     # i32[rows] — messages consumed this tick (EmulNet.cpp:172)
 
 
-def _row_keyed_uniform(key: jax.Array, row_ids: jax.Array, n: int) -> jax.Array:
-    """Per-row PRNG: row s draws its own (N,) uniforms from
-    ``fold_in(key, s)``.  Keyed by *global* row id so the single-device
-    and sharded paths produce bit-identical drop patterns."""
-    return jax.vmap(
-        lambda r: jax.random.uniform(jax.random.fold_in(key, r), (n,))
-    )(row_ids)
-
-
-def make_tick(cfg: SimConfig, block_size: int = 128, comm=None):
+def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
+              use_pallas: bool | None = None):
     """Build the tick function for a config (shapes are static).
 
     Returned signature: ``tick(state, sched) -> (state', TickEvents)``.
     With a :class:`RingComm`, call it inside ``shard_map`` with (N, N)
     arrays sharded ``P(axis, None)`` and everything else replicated.
+    ``use_pallas`` routes the merge reduction through the fused Pallas
+    kernel (None = auto: on for TPU backends); ignored when an explicit
+    ``comm`` is passed (the comm carries its own merge implementation).
     """
-    comm = comm or LocalComm()
+    comm = comm or LocalComm(use_pallas)
     n = cfg.n
     t_remove = cfg.t_remove
     assert n % comm.n_shards == 0, "peer count must divide the mesh axis"
@@ -192,13 +192,9 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None):
         send = ops_rows[:, None] & known
 
         # ---- ENsend drop injection (EmulNet.cpp:90-94) -------------
-        key = jax.random.fold_in(state.rng, t)
-        kg, kq, kp = jax.random.split(key, 3)
-        active = sched.drop_active[t]
-        p_drop = sched.drop_prob
-        gdrop = active & (_row_keyed_uniform(kg, row_ids, n) < p_drop)
-        qdrop = active & (jax.random.uniform(kq, (n,)) < p_drop)
-        pdrop = active & (jax.random.uniform(kp, (n,)) < p_drop)
+        gdrop_all, qdrop, pdrop = tick_drop_masks(
+            state.rng, t, n, sched.drop_active[t], sched.drop_prob)
+        gdrop = comm.slice_rows(gdrop_all)               # local sender rows
         gossip_sent = send & ~gdrop
         joinreq_sent = joinreq_new & ~qdrop
         joinrep_sent = rep_out & ~pdrop
@@ -254,17 +250,20 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None):
 _RUN_CACHE: dict = {}
 
 
-def make_run(cfg: SimConfig, block_size: int = 128, with_events: bool = True):
+def make_run(cfg: SimConfig, block_size: int = 128, with_events: bool = True,
+             use_pallas: bool | None = None):
     """Whole-run function: ``lax.scan`` of the tick over all T ticks.
 
     Returns a jitted ``run(state, sched) -> (final_state, stacked_events)``.
     With ``with_events=False`` only the send/recv counters are stacked
     (benchmark mode — avoids materializing T*(N,N) masks).
     """
-    key = (cfg.n, cfg.t_remove, cfg.total_ticks, block_size, with_events)
+    comm = LocalComm(use_pallas)
+    key = (cfg.n, cfg.t_remove, cfg.total_ticks, block_size, with_events,
+           comm.use_pallas)
     if key in _RUN_CACHE:
         return _RUN_CACHE[key]
-    tick = make_tick(cfg, block_size)
+    tick = make_tick(cfg, block_size, comm=comm)
 
     @jax.jit
     def run(state: WorldState, sched: Schedule):

@@ -3,26 +3,47 @@
 Replaces ``EmulNet::ENsend``'s drop check (EmulNet.cpp:90-94):
 ``rand() % 100 < MSG_DROP_PROB * 100`` while the ``dropmsg`` window is
 open.  The reference's ``srand(time(NULL))`` (Application.cpp:50,96)
-makes runs irreproducible; here the mask comes from a per-tick
+makes runs irreproducible; here the masks come from a per-tick
 ``jax.random`` key so every run is replayable from the config seed.
+
+One (N+2, N) uniform draw covers every send class of a tick — gossip
+lattice rows, JOINREQ vector, JOINREP vector — so the whole tick costs
+a single PRNG kernel, and the draw is skipped entirely outside the drop
+window (a ``lax.cond`` on the window flag).  The gossip rows are keyed
+by *global* sender index, so a sharded tick slices its local rows out
+of the identical lattice and the single-device and multi-device paths
+produce bit-identical drop patterns (testing/dropsync.py replays the
+same draw for the differential oracle).
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 
-def drop_mask(key: jax.Array, shape, active, prob) -> jax.Array:
-    """bool mask: True where a send is dropped.
+def tick_drop_masks(rng: jax.Array, t: jax.Array, n: int, active, prob):
+    """Per-tick drop decisions for all three send classes.
 
     Args:
-      key:    per-tick PRNG key (fold the tick index into the run key).
-      shape:  shape of the send lattice to mask.
+      rng:    the run's PRNG key (tick index is folded in here).
+      t:      i32 scalar — current tick.
+      n:      peer count (static).
       active: bool scalar — is the drop window open for this tick's
         sends?  (dropmsg is set after tick 50 and cleared after tick
         300, Application.cpp:177-200, so sends during ticks [51, 300]
         are droppable.)
       prob:   f32 scalar drop probability (MSG_DROP_PROB).
+
+    Returns:
+      gossip_drop bool[N, N] (sender-major), joinreq_drop bool[N],
+      joinrep_drop bool[N].
     """
-    return active & (jax.random.uniform(key, shape) < prob)
+    def draw(_):
+        u = jax.random.uniform(jax.random.fold_in(rng, t), (n + 2, n))
+        return u < prob
+
+    drop = lax.cond(active, draw,
+                    lambda _: jnp.zeros((n + 2, n), bool), None)
+    return drop[:n], drop[n], drop[n + 1]

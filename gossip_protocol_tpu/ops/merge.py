@@ -8,11 +8,15 @@ reduction over the sender axis — a (max, select) semiring "matmul":
 
     M[r, j] = max over s of  hb[s, j]   where  recv_from[r, s] and known[s, j]
 
-Four reductions share the same pass (all-sources max, fresh-sources max,
-fresh-sources timestamp max, fresh-source existence); they are computed
-blockwise over the sender axis with ``lax.scan`` so peak memory stays
-O(R * B * J) instead of O(R * S * J).  A Pallas kernel with the same
-contract lives in ``ops/pallas/maxmerge.py`` for the hot path.
+The mask-select is expressed as a *product*: with payloads shifted up by
+one (``A1 = known ? hb+1 : 0``) and the delivery mask as int 0/1, the
+masked select is ``d * A1`` (one VPU multiply instead of a
+select/where), the reduction is a plain max, and the no-contribution
+case falls out as 0 → FILL after shifting back down.  Three such
+product-max reductions share one blockwise pass over the sender axis
+(``lax.scan``), so peak memory stays O(R * B * J) instead of
+O(R * S * J).  A Pallas kernel with the same contract lives in
+``ops/pallas/maxmerge.py`` for the TPU hot path.
 """
 
 from __future__ import annotations
@@ -27,6 +31,23 @@ from jax import lax
 #: (entries are created with heartbeat 1, MP1Node.cpp:270) and real
 #: timestamps are >= 0, so -1 is unambiguous.
 FILL = jnp.int32(-1)
+
+
+def merge_payloads(known, hb, ts, now, t_remove):
+    """Shift-encoded payload planes for the product-max reductions.
+
+    Returns int32 [S, J] planes:
+      a1 — ``known ? hb + 1 : 0``            (all contributions)
+      f1 — ``fresh ? hb + 1 : 0``            (fresh contributions)
+      t1 — ``fresh ? ts + 1 : 0``            (fresh timestamps)
+    where *fresh* is the receive-time add gate ``now - ts < t_remove``
+    (MP1Node.cpp:294).  Heartbeats/timestamps are bounded by the run
+    length (<= MAX_TIME 3600, EmulNet.h:11), so the +1 shift never
+    overflows and 0 unambiguously encodes "nothing".
+    """
+    k = known.astype(jnp.int32)
+    fresh = k * (now - ts < t_remove)
+    return k * (hb + 1), fresh * (hb + 1), fresh * (ts + 1)
 
 
 @partial(jax.jit, static_argnames=("t_remove", "block_size"))
@@ -62,35 +83,35 @@ def gossip_reductions(recv_from, known, hb, ts, now, *,
     nb = -(-s_dim // b)
     pad = nb * b - s_dim
 
+    a1, f1, t1 = merge_payloads(known, hb, ts, now, t_remove)
+    d = recv_from.astype(jnp.int32)
     if pad:
-        recv_from = jnp.pad(recv_from, ((0, 0), (0, pad)))
-        known = jnp.pad(known, ((0, pad), (0, 0)))
-        hb = jnp.pad(hb, ((0, pad), (0, 0)))
-        ts = jnp.pad(ts, ((0, pad), (0, 0)))
+        d = jnp.pad(d, ((0, 0), (0, pad)))
+        a1 = jnp.pad(a1, ((0, pad), (0, 0)))
+        f1 = jnp.pad(f1, ((0, pad), (0, 0)))
+        t1 = jnp.pad(t1, ((0, pad), (0, 0)))
 
-    recv_blocks = recv_from.reshape(r_dim, nb, b).transpose(1, 0, 2)  # [nb, R, B]
-    known_blocks = known.reshape(nb, b, j_dim)
-    hb_blocks = hb.reshape(nb, b, j_dim)
-    ts_blocks = ts.reshape(nb, b, j_dim)
+    d_blocks = d.reshape(r_dim, nb, b).transpose(1, 0, 2)   # [nb, R, B]
+    a1_blocks = a1.reshape(nb, b, j_dim)
+    f1_blocks = f1.reshape(nb, b, j_dim)
+    t1_blocks = t1.reshape(nb, b, j_dim)
 
     # Derive the accumulator initializers from the inputs (instead of
     # plain constants) so that under shard_map they carry the same
     # varying-axis type as the per-block contributions — a constant
     # init would make the scan carry type-mismatch on a sharded mesh.
-    zero = recv_from[:, :1].astype(jnp.int32) * (hb[:1, :] * 0)
-    init = (zero + FILL, zero + FILL, zero + FILL, zero.astype(bool))
+    zero = d[:, :1] * (a1[:1, :] * 0)
+    init = (zero, zero, zero)
 
     def body(carry, blk):
-        m_all, m_fr, t_fr, anyf = carry
-        d, kn, h, tsb = blk
-        contrib = d[:, :, None] & kn[None]                    # [R, B, J]
-        m_all = jnp.maximum(m_all, jnp.where(contrib, h[None], FILL).max(1))
-        fresh = contrib & (now - tsb[None] < t_remove)
-        m_fr = jnp.maximum(m_fr, jnp.where(fresh, h[None], FILL).max(1))
-        t_fr = jnp.maximum(t_fr, jnp.where(fresh, tsb[None], FILL).max(1))
-        anyf = anyf | fresh.any(1)
-        return (m_all, m_fr, t_fr, anyf), None
+        m_a, m_f, m_t = carry
+        db, a1b, f1b, t1b = blk
+        dx = db[:, :, None]                                  # [R, B, 1]
+        m_a = jnp.maximum(m_a, (dx * a1b[None]).max(1))
+        m_f = jnp.maximum(m_f, (dx * f1b[None]).max(1))
+        m_t = jnp.maximum(m_t, (dx * t1b[None]).max(1))
+        return (m_a, m_f, m_t), None
 
-    (m_all, m_fr, t_fr, anyf), _ = lax.scan(
-        body, init, (recv_blocks, known_blocks, hb_blocks, ts_blocks))
-    return m_all, m_fr, t_fr, anyf
+    (m_a, m_f, m_t), _ = lax.scan(
+        body, init, (d_blocks, a1_blocks, f1_blocks, t1_blocks))
+    return m_a - 1, m_f - 1, m_t - 1, m_t > 0

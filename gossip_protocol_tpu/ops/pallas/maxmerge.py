@@ -1,23 +1,26 @@
 """Pallas TPU kernel for the fused gossip-merge reduction.
 
-Computes the same four maxima as ``ops.merge.gossip_reductions`` — the
-(max, and) semiring "matmul" that replaces the reference's per-message
-linear-scan merge (MP1Node.cpp:236-256) — in one fused pass:
+Computes the same three product-max reductions as
+``ops.merge.gossip_reductions`` — the (max, and) semiring "matmul" that
+replaces the reference's per-message linear-scan merge
+(MP1Node.cpp:236-256) — in one fused pass:
 
-    m_all[r, j]  = max_s { hb[s, j] : recv[r, s] & known[s, j] }
-    m_fr / t_fr  = ditto restricted to fresh entries (now - ts < TREMOVE)
-    anyf[r, j]   = fresh contribution exists
+    m_a[r, j] = max_s  d[r, s] * a1[s, j]     (a1 = known ? hb+1 : 0)
+    m_f, m_t  = ditto over the fresh payload planes f1 / t1
 
 Grid is (R/TR, J/TJ, S/TS) with the sender axis innermost; each program
-max-accumulates its (TR, TJ) output tile in VMEM across sender tiles,
+max-accumulates its (TR, TJ) output tiles in VMEM across sender tiles,
 so the O(R*S*J) semiring contraction never round-trips HBM between
 sender blocks.  Inside a tile the sender axis is consumed in sublane
-chunks of 8 (the VPU's sublane width for 32-bit lanes), keeping the 3-D
-broadcast intermediate at (TR, 8, TJ).
+chunks of 8 (the VPU's sublane width for 32-bit lanes): each chunk is a
+(TR, 8) x (8, TJ) broadcast-multiply-max — two VPU ops per cell per
+reduction, with the (TR, 8, TJ) intermediate living entirely in
+registers/VMEM.
 
-Masks travel as int32 0/1 (TPU-friendly tiling); the public wrapper
-accepts/returns the same dtypes as the XLA-path op.  ``interpret=True``
-is used automatically off-TPU so the kernel is testable on CPU.
+The public wrapper pads arbitrary shapes up to tile multiples (padded
+delivery rows are all-zero, so they contribute nothing) and accepts the
+same dtypes as the XLA-path op.  ``interpret=True`` is used
+automatically off-TPU so the kernel is testable on CPU.
 """
 
 from __future__ import annotations
@@ -29,51 +32,48 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..merge import FILL
+from ..merge import merge_payloads
 
 _SUB = 8  # sender sublane chunk
 
 
-def _kernel(t_remove: int, ts_tile: int,
-            now_ref, recv_ref, known_ref, hb_ref, ts_ref,
-            m_all_ref, m_fr_ref, t_fr_ref, anyf_ref):
+def _kernel(tr_tile: int,
+            d_ref, a1_ref, f1_ref, t1_ref,
+            m_a_ref, m_f_ref, m_t_ref):
     k = pl.program_id(2)
 
     @pl.when(k == 0)
     def _init():
-        m_all_ref[:] = jnp.full_like(m_all_ref, FILL)
-        m_fr_ref[:] = jnp.full_like(m_fr_ref, FILL)
-        t_fr_ref[:] = jnp.full_like(t_fr_ref, FILL)
-        anyf_ref[:] = jnp.zeros_like(anyf_ref)
+        m_a_ref[:] = jnp.zeros_like(m_a_ref)
+        m_f_ref[:] = jnp.zeros_like(m_f_ref)
+        m_t_ref[:] = jnp.zeros_like(m_t_ref)
 
-    now = now_ref[0]
-    recv = recv_ref[:]          # (TR, TS) int32 0/1
-    known = known_ref[:]        # (TS, TJ)
-    hb = hb_ref[:]
-    ts = ts_ref[:]
-    fresh_row = (now - ts < t_remove)  # (TS, TJ) bool
+    d = d_ref[:]                # (TR, TS) int32 0/1
+    a1 = a1_ref[:]              # (TS, TJ)
+    f1 = f1_ref[:]
+    t1 = t1_ref[:]
 
-    m_all = m_all_ref[:]
-    m_fr = m_fr_ref[:]
-    t_fr = t_fr_ref[:]
-    anyf = anyf_ref[:]
+    # Receiver axis in static sublane chunks: every slice below is
+    # sublane-aligned (lane-dimension slicing at non-128 offsets does
+    # not lower on Mosaic, and slice+newaxis in one indexing expression
+    # lowers via gather — hence the explicit expand_dims), and the
+    # (8, TS, TJ) broadcast-product keeps the sender axis on sublanes
+    # where the max-reduce is native.
+    a1x = jnp.expand_dims(a1, 0)                     # (1, TS, TJ)
+    f1x = jnp.expand_dims(f1, 0)
+    t1x = jnp.expand_dims(t1, 0)
+    for r0 in range(0, tr_tile, _SUB):
+        dx = jnp.expand_dims(d[r0:r0 + _SUB, :], 2)  # (8, TS, 1)
+        m_a_ref[r0:r0 + _SUB, :] = jnp.maximum(
+            m_a_ref[r0:r0 + _SUB, :], (dx * a1x).max(1))
+        m_f_ref[r0:r0 + _SUB, :] = jnp.maximum(
+            m_f_ref[r0:r0 + _SUB, :], (dx * f1x).max(1))
+        m_t_ref[r0:r0 + _SUB, :] = jnp.maximum(
+            m_t_ref[r0:r0 + _SUB, :], (dx * t1x).max(1))
 
-    for s0 in range(0, ts_tile, _SUB):
-        d8 = recv[:, s0:s0 + _SUB] > 0                    # (TR, 8)
-        k8 = known[s0:s0 + _SUB] > 0                      # (8, TJ)
-        contrib = d8[:, :, None] & k8[None]               # (TR, 8, TJ)
-        hb8 = hb[s0:s0 + _SUB][None]
-        m_all = jnp.maximum(m_all, jnp.where(contrib, hb8, FILL).max(1))
-        fresh = contrib & fresh_row[s0:s0 + _SUB][None]
-        m_fr = jnp.maximum(m_fr, jnp.where(fresh, hb8, FILL).max(1))
-        t_fr = jnp.maximum(t_fr,
-                           jnp.where(fresh, ts[s0:s0 + _SUB][None], FILL).max(1))
-        anyf = anyf | fresh.any(1).astype(jnp.int32)
 
-    m_all_ref[:] = m_all
-    m_fr_ref[:] = m_fr
-    t_fr_ref[:] = t_fr
-    anyf_ref[:] = anyf
+def _ceil_to(x: int, m: int) -> int:
+    return -(-x // m) * m
 
 
 @functools.partial(jax.jit, static_argnames=("t_remove", "tile_r", "tile_j",
@@ -84,43 +84,52 @@ def gossip_reductions_pallas(recv_from, known, hb, ts, now, *,
                              interpret: bool | None = None):
     """Drop-in Pallas implementation of ``ops.merge.gossip_reductions``.
 
-    Shapes must tile evenly (pad at the call site if needed; the tick
-    path uses power-of-two N for the dense model).
+    Arbitrary shapes are padded up to tile multiples; padded rows and
+    columns are sliced back off the outputs.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     r_dim, s_dim = recv_from.shape
     j_dim = known.shape[1]
-    tr = min(tile_r, r_dim)
-    tj = min(tile_j, j_dim)
-    tss = min(tile_s, s_dim)
-    assert r_dim % tr == 0 and j_dim % tj == 0 and s_dim % tss == 0 \
-        and tss % _SUB == 0, (r_dim, s_dim, j_dim, tr, tj, tss)
 
-    grid = (r_dim // tr, j_dim // tj, s_dim // tss)
-    out_shape = [jax.ShapeDtypeStruct((r_dim, j_dim), jnp.int32)] * 4
+    a1, f1, t1 = merge_payloads(known, hb, ts, now, t_remove)
+    d = recv_from.astype(jnp.int32)
+
+    tr = min(tile_r, _ceil_to(r_dim, _SUB))
+    tj = min(tile_j, _ceil_to(j_dim, 128))
+    tss = min(tile_s, _ceil_to(s_dim, _SUB))
+    rp, jp, sp = _ceil_to(r_dim, tr), _ceil_to(j_dim, tj), _ceil_to(s_dim, tss)
+    if (rp, sp) != (r_dim, s_dim):
+        d = jnp.pad(d, ((0, rp - r_dim), (0, sp - s_dim)))
+    if (sp, jp) != (s_dim, j_dim):
+        pad = ((0, sp - s_dim), (0, jp - j_dim))
+        a1, f1, t1 = jnp.pad(a1, pad), jnp.pad(f1, pad), jnp.pad(t1, pad)
+
+    grid = (rp // tr, jp // tj, sp // tss)
+    out_shape = [jax.ShapeDtypeStruct((rp, jp), jnp.int32)] * 3
     out_spec = pl.BlockSpec((tr, tj), lambda i, j, k: (i, j),
                             memory_space=pltpu.VMEM)
 
-    m_all, m_fr, t_fr, anyf = pl.pallas_call(
-        functools.partial(_kernel, t_remove, tss),
+    m_a, m_f, m_t = pl.pallas_call(
+        functools.partial(_kernel, tr),
         grid=grid,
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),               # now
             pl.BlockSpec((tr, tss), lambda i, j, k: (i, k),
-                         memory_space=pltpu.VMEM),               # recv_from
+                         memory_space=pltpu.VMEM),               # d
             pl.BlockSpec((tss, tj), lambda i, j, k: (k, j),
-                         memory_space=pltpu.VMEM),               # known
+                         memory_space=pltpu.VMEM),               # a1
             pl.BlockSpec((tss, tj), lambda i, j, k: (k, j),
-                         memory_space=pltpu.VMEM),               # hb
+                         memory_space=pltpu.VMEM),               # f1
             pl.BlockSpec((tss, tj), lambda i, j, k: (k, j),
-                         memory_space=pltpu.VMEM),               # ts
+                         memory_space=pltpu.VMEM),               # t1
         ],
-        out_specs=[out_spec] * 4,
+        out_specs=[out_spec] * 3,
         out_shape=out_shape,
         interpret=interpret,
-    )(jnp.asarray([now], jnp.int32),
-      recv_from.astype(jnp.int32), known.astype(jnp.int32),
-      hb.astype(jnp.int32), ts.astype(jnp.int32))
+    )(d, a1, f1, t1)
 
-    return m_all, m_fr, t_fr, anyf.astype(bool)
+    if (rp, jp) != (r_dim, j_dim):
+        m_a = m_a[:r_dim, :j_dim]
+        m_f = m_f[:r_dim, :j_dim]
+        m_t = m_t[:r_dim, :j_dim]
+    return m_a - 1, m_f - 1, m_t - 1, m_t > 0

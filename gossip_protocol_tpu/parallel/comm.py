@@ -18,6 +18,12 @@ collective-communication abstraction over the peer-sharded state:
   applied to the peer axis (SURVEY.md §2.3).  Collectives ride ICI
   inside a slice / DCN across slices; nothing here assumes either.
 
+Both backends take ``use_pallas``: True routes the merge reduction
+through the fused Pallas TPU kernel (ops/pallas/maxmerge.py), False
+through the blockwise XLA op (ops/merge.py), None picks by backend
+(Pallas on TPU).  The two implementations share one output contract and
+are differentially tested against each other (tests/test_pallas.py).
+
 The tick body is written once against this interface; sharding is a
 deployment choice, not a code path fork.
 """
@@ -31,10 +37,32 @@ from jax import lax
 from ..ops.merge import FILL, gossip_reductions
 
 
+def _resolve_use_pallas(use_pallas):
+    if use_pallas is None:
+        return jax.default_backend() == "tpu"
+    return bool(use_pallas)
+
+
+def _merge_fn(use_pallas: bool):
+    if use_pallas:
+        from ..ops.pallas.maxmerge import gossip_reductions_pallas
+
+        def run(recv_from, known, hb, ts, now, *, t_remove, block_size):
+            return gossip_reductions_pallas(
+                recv_from, known, hb, ts, now, t_remove=t_remove,
+                tile_s=block_size)
+        return run
+    return gossip_reductions
+
+
 class LocalComm:
     """Single-device (or fully-replicated) execution."""
 
     n_shards = 1
+
+    def __init__(self, use_pallas: bool | None = None):
+        self.use_pallas = _resolve_use_pallas(use_pallas)
+        self._merge = _merge_fn(self.use_pallas)
 
     def row_ids(self, n: int) -> jax.Array:
         """Global row indices of the locally-held row block."""
@@ -52,10 +80,14 @@ class LocalComm:
         """[local_rows] -> [N] (already global locally)."""
         return v_local
 
+    def slice_rows(self, x: jax.Array) -> jax.Array:
+        """Slice a replicated [N, ...] array down to the local rows."""
+        return x
+
     def merge_reduce(self, recv_from, known, hb, ts, now, *,
                      t_remove: int, block_size: int):
-        return gossip_reductions(recv_from, known, hb, ts, now,
-                                 t_remove=t_remove, block_size=block_size)
+        return self._merge(recv_from, known, hb, ts, now,
+                           t_remove=t_remove, block_size=block_size)
 
 
 class RingComm:
@@ -66,9 +98,12 @@ class RingComm:
     ``n`` must be divisible by the mesh axis size.
     """
 
-    def __init__(self, axis_name: str, n_shards: int):
+    def __init__(self, axis_name: str, n_shards: int,
+                 use_pallas: bool | None = None):
         self.axis = axis_name
         self.n_shards = n_shards
+        self.use_pallas = _resolve_use_pallas(use_pallas)
+        self._merge = _merge_fn(self.use_pallas)
 
     def row_ids(self, n: int) -> jax.Array:
         nl = n // self.n_shards
@@ -91,6 +126,11 @@ class RingComm:
     def gather_rows(self, v_local: jax.Array) -> jax.Array:
         return lax.all_gather(v_local, self.axis, tiled=True)
 
+    def slice_rows(self, x: jax.Array) -> jax.Array:
+        nl = x.shape[0] // self.n_shards
+        start = lax.axis_index(self.axis) * nl
+        return lax.dynamic_slice_in_dim(x, start, nl, axis=0)
+
     def merge_reduce(self, recv_from, known, hb, ts, now, *,
                      t_remove: int, block_size: int):
         """Ring max-accumulation over rotating payload blocks.
@@ -102,14 +142,15 @@ class RingComm:
         p = self.n_shards
         me = lax.axis_index(self.axis)
         perm = [(i, (i + 1) % p) for i in range(p)]
+        merge = self._merge
 
         def step(k, carry):
             m_all, m_fr, t_fr, anyf, kb, hbb, tsb = carry
             # the rotating block currently holds rows of origin device o
             o = (me - k) % p
             cols = lax.dynamic_slice(recv_from, (0, o * nl), (nl, nl))
-            r = gossip_reductions(cols, kb, hbb, tsb, now,
-                                  t_remove=t_remove, block_size=block_size)
+            r = merge(cols, kb, hbb, tsb, now,
+                      t_remove=t_remove, block_size=block_size)
             m_all = jnp.maximum(m_all, r[0])
             m_fr = jnp.maximum(m_fr, r[1])
             t_fr = jnp.maximum(t_fr, r[2])

@@ -56,7 +56,8 @@ _SHARDED_CACHE: dict = {}
 
 
 def make_sharded_run(cfg: SimConfig, mesh: Mesh, block_size: int = 128,
-                     with_events: bool = True, axis: str = PEER_AXIS):
+                     with_events: bool = True, axis: str = PEER_AXIS,
+                     use_pallas: bool | None = None):
     """Build ``run(state, sched) -> (final_state, events)`` with the
     scan-over-ticks inside ``shard_map`` over ``mesh``.
 
@@ -64,12 +65,11 @@ def make_sharded_run(cfg: SimConfig, mesh: Mesh, block_size: int = 128,
     [T, N//P, N] per device, i.e. logically [T, N, N] sharded on axis 1.
     """
     n_shards = mesh.devices.size
+    comm = RingComm(axis, n_shards, use_pallas)
     key = (cfg.n, cfg.t_remove, cfg.total_ticks, block_size, with_events,
-           n_shards, axis, id(mesh))
+           n_shards, axis, id(mesh), comm.use_pallas)
     if key in _SHARDED_CACHE:
         return _SHARDED_CACHE[key]
-
-    comm = RingComm(axis, n_shards)
     tick = make_tick(cfg, block_size, comm=comm)
 
     state_specs = _state_specs(axis)

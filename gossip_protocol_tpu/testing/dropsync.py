@@ -1,15 +1,15 @@
 """Shared drop-decision precomputation for differential tests.
 
-Replays the tick function's exact PRNG usage (core/tick.py: per-tick
-``fold_in`` + 3-way split, gossip/joinreq/joinrep masks in that order)
-so the scalar oracle can consume the very same drop decisions the
-vectorized simulation will draw on device.
+Replays the tick function's exact PRNG usage (ops/drop.py
+``tick_drop_masks``: one per-tick ``fold_in`` + one (N+2, N) uniform
+draw covering gossip rows, JOINREQ, and JOINREP in that order) so the
+scalar oracle can consume the very same drop decisions the vectorized
+simulation will draw on device.
 """
 
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ..config import SimConfig
@@ -27,15 +27,10 @@ def make_drop_masks(cfg: SimConfig, sched: Schedule):
     g = np.zeros((t_total, n, n), bool)
     q = np.zeros((t_total, n), bool)
     r = np.zeros((t_total, n), bool)
-    rows = jnp.arange(n, dtype=jnp.int32)
-    row_uniform = jax.jit(jax.vmap(
-        lambda k, row: jax.random.uniform(jax.random.fold_in(k, row), (n,)),
-        in_axes=(None, 0)))
+    draw = jax.jit(lambda k: jax.random.uniform(k, (n + 2, n)) < p)
     for t in range(t_total):
         if not active[t]:
             continue
-        kg, kq, kp = jax.random.split(jax.random.fold_in(base, t), 3)
-        g[t] = np.asarray(row_uniform(kg, rows) < p)
-        q[t] = np.asarray(jax.random.uniform(kq, (n,)) < p)
-        r[t] = np.asarray(jax.random.uniform(kp, (n,)) < p)
+        drop = np.asarray(draw(jax.random.fold_in(base, t)))
+        g[t], q[t], r[t] = drop[:n], drop[n], drop[n + 1]
     return g, q, r

@@ -59,8 +59,12 @@ def test_full_parity(scenario, seed):
         frozen = (np.asarray(res.fail_tick) <= cfg.total_ticks)[:, None]
         assert not (ts_diff * ~frozen).any()
         assert np.abs(ts_diff).max() <= 1
+    # Heartbeat counters seeded during the join transient carry a
+    # persistent canonical-order offset; two independently-seeded
+    # offsets can stack along a gossip path under drop (core/tick.py
+    # docstring), so the bound is 1 without drop and 2 with.
     hb_diff = o.table("hb") - np.asarray(res.final_state.hb) * km
-    assert np.abs(hb_diff).max() <= 1
+    assert np.abs(hb_diff).max() <= (2 if cfg.drop_msg else 1)
 
     # accounting parity (drives msgcount.log, EmulNet.cpp:184-220)
     if not cfg.drop_msg:
