@@ -16,14 +16,17 @@ partial-view overlays (``models/overlay.py``).
 
 from .config import (INTRODUCER, MSG_DROP_SINGLE_FAILURE, MULTI_FAILURE,
                      SINGLE_FAILURE, SimConfig)
-from .state import Schedule, WorldState, init_state, make_schedule
+from .state import (Schedule, WorldState, init_state, load_checkpoint,
+                    make_schedule, save_checkpoint, state_from_host,
+                    state_to_host)
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 __all__ = [
-    "SimConfig", "SimPreset", "INTRODUCER",
+    "SimConfig", "INTRODUCER",
     "SINGLE_FAILURE", "MULTI_FAILURE", "MSG_DROP_SINGLE_FAILURE",
     "WorldState", "Schedule", "init_state", "make_schedule",
+    "state_to_host", "state_from_host", "save_checkpoint", "load_checkpoint",
     "Simulation", "run_scenario",
 ]
 

@@ -59,7 +59,13 @@ def main(argv=None) -> int:
         overrides["max_nnb"] = args.peers
     if args.ticks is not None:
         overrides["total_ticks"] = args.ticks
-    cfg = SimConfig.from_conf(args.conf, **overrides)
+    try:
+        cfg = SimConfig.from_conf(args.conf, **overrides)
+    except (OSError, ValueError) as e:
+        # clean diagnostic + the native launcher's conf-error exit code
+        # (gossip_app.cc), instead of a raw traceback
+        print(f"gossip_protocol_tpu: {e}", file=sys.stderr)
+        return 2
 
     from .core.sim import Simulation
 

@@ -100,6 +100,12 @@ class SimConfig:
                 m = re.match(r"\s*([A-Z_]+)\s*:\s*([0-9.eE+-]+)", line)
                 if m:
                     keys[m.group(1)] = m.group(2)
+        if "MAX_NNB" not in keys and "max_nnb" not in overrides:
+            # A conf that never mentions MAX_NNB is malformed or
+            # mis-pathed (the reference's positional fscanf would read
+            # garbage, Params.cpp:22-25); refuse to silently simulate
+            # the defaults.  native/params.cc applies the same rule.
+            raise ValueError(f"no MAX_NNB key in {path}")
         kw = {}
         if "MAX_NNB" in keys:
             kw["max_nnb"] = int(keys["MAX_NNB"])

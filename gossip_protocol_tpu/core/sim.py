@@ -31,7 +31,7 @@ from .tick import make_run, make_tick
 
 @dataclass
 class SimResult:
-    """Host-side digest of a finished run."""
+    """Host-side digest of a finished run (or resumed run segment)."""
 
     cfg: SimConfig
     start_tick: np.ndarray   # i32[N]
@@ -42,11 +42,17 @@ class SimResult:
     recv: np.ndarray         # i32[N, T]
     final_state: WorldState
     wall_seconds: float
+    first_tick: int = 0      # absolute tick of added[0] (0 unless resumed)
+    resumed: bool = False    # True for a continuation segment (no boot lines)
 
     def events(self) -> list[LogEvent]:
         assert self.added is not None, "events need a trace-mode run"
+        # boot-line emission is decided by event_stream's default rule:
+        # non-empty segments starting at tick 0 (covers resumption from
+        # a tick-0 checkpoint without duplicating mid-run continuations)
         return list(event_stream(self.cfg, self.start_tick, self.fail_tick,
-                                 self.added, self.removed))
+                                 self.added, self.removed,
+                                 first_tick=self.first_tick))
 
     def grader_view(self) -> dict:
         return grader_view(self.events())
@@ -58,8 +64,13 @@ class SimResult:
 
     # --- convenience metrics ---------------------------------------
     @property
+    def ticks_run(self) -> int:
+        """Ticks actually executed in this (possibly partial) segment."""
+        return self.sent.shape[1]
+
+    @property
     def ticks_per_second(self) -> float:
-        return self.cfg.total_ticks / self.wall_seconds
+        return self.ticks_run / self.wall_seconds
 
     @property
     def node_ticks_per_second(self) -> float:
@@ -91,17 +102,35 @@ class Simulation:
                                                 use_pallas=self.use_pallas)
         return self._trace_runs[length]
 
-    def run(self, seed: Optional[int] = None) -> SimResult:
-        """Trace-mode run: full event masks for logging/grading."""
+    def run(self, seed: Optional[int] = None,
+            resume_from: Optional[WorldState] = None,
+            ticks: Optional[int] = None) -> SimResult:
+        """Trace-mode run: full event masks for logging/grading.
+
+        ``resume_from`` continues a previous (possibly checkpointed)
+        state — the clock, in-flight traffic, and PRNG key are all part
+        of the state, so the continuation is bit-identical to an
+        uninterrupted run (the reference cannot do this at all: it
+        always runs 0..700, Application.cpp:99).  ``ticks`` stops the
+        segment early (e.g. to checkpoint mid-run); the default runs
+        through ``cfg.total_ticks``.
+        """
+        if seed is not None and resume_from is not None:
+            raise ValueError(
+                "seed and resume_from are mutually exclusive: a reseeded "
+                "schedule would not be the one that produced the resumed "
+                "state")
         cfg = self.cfg if seed is None else self.cfg.replace(seed=seed)
         sched = make_schedule(cfg)
-        state = init_state(cfg)
-        t_total = cfg.total_ticks
+        state = init_state(cfg) if resume_from is None else resume_from
+        first = int(np.asarray(state.tick))
+        t_end = cfg.total_ticks if ticks is None \
+            else min(cfg.total_ticks, first + ticks)
         added, removed, sent, recv = [], [], [], []
         t0 = time.perf_counter()
-        done = 0
-        while done < t_total:
-            length = min(self.chunk_ticks, t_total - done)
+        done = first
+        while done < t_end:
+            length = min(self.chunk_ticks, t_end - done)
             run = self._trace_run_fn(length)
             state, ev = run(state, sched)
             added.append(np.asarray(ev.added))
@@ -110,6 +139,11 @@ class Simulation:
             recv.append(np.asarray(ev.recv))
             done += length
         wall = time.perf_counter() - t0
+        if not added:   # zero-length segment (already at/after t_end)
+            added = [np.zeros((0, cfg.n, cfg.n), bool)]
+            removed = [np.zeros((0, cfg.n, cfg.n), bool)]
+            sent = [np.zeros((0, cfg.n), np.int32)]
+            recv = [np.zeros((0, cfg.n), np.int32)]
         return SimResult(
             cfg=cfg,
             start_tick=np.asarray(sched.start_tick),
@@ -120,6 +154,8 @@ class Simulation:
             recv=np.concatenate(recv, 0).T.copy(),
             final_state=state,
             wall_seconds=wall,
+            first_tick=first,
+            resumed=resume_from is not None,
         )
 
     def run_bench(self, seed: Optional[int] = None, warmup: bool = True) -> SimResult:

@@ -37,26 +37,39 @@ class LogEvent:
 
 
 def event_stream(cfg: SimConfig, start_tick: np.ndarray, fail_tick: np.ndarray,
-                 added: np.ndarray, removed: np.ndarray) -> Iterator[LogEvent]:
-    """Yield the full run's dbg.log events in reference order.
+                 added: np.ndarray, removed: np.ndarray,
+                 first_tick: int = 0,
+                 include_boot: Optional[bool] = None) -> Iterator[LogEvent]:
+    """Yield the run's dbg.log events in reference order.
 
     Args:
       cfg:        scenario config.
       start_tick: i32[N] introduction ticks (Application.cpp:143).
       fail_tick:  i32[N] failure ticks (NEVER sentinel = never fails).
       added:      bool[T, N, N] — added[t, i, j]: observer i logged a
-                  join for subject j during tick t.
+                  join for subject j during (absolute) tick
+                  ``first_tick + t``.
       removed:    bool[T, N, N] — ditto for removals.
+      first_tick: absolute tick of ``added[0]`` — nonzero when the run
+                  segment was resumed from a checkpoint.
+      include_boot: emit the per-node "APP" boot lines.  Default: for
+                  non-empty segments starting at tick 0 — a fresh run
+                  and a run resumed from a tick-0 checkpoint both get
+                  them exactly once, while a zero-length segment or a
+                  mid-run continuation never duplicates them.
     """
     n = cfg.n
     t_total = added.shape[0]
 
     # "APP" boot lines: one per node at construction time, forward order
     # (Application.cpp:59-69), stamped with tick 0.
-    for i in range(n):
-        yield LogEvent(i, 0, "APP")
+    emit_boot = include_boot if include_boot is not None \
+        else (first_tick == 0 and t_total > 0)
+    if emit_boot:
+        for i in range(n):
+            yield LogEvent(i, 0, "APP")
 
-    for t in range(t_total):
+    for t in range(first_tick, first_tick + t_total):
         for i in range(n - 1, -1, -1):
             if t == start_tick[i]:
                 # nodeStart logs (MP1Node.cpp:126-144)
@@ -65,10 +78,10 @@ def event_stream(cfg: SimConfig, start_tick: np.ndarray, fail_tick: np.ndarray,
                 else:
                     yield LogEvent(i, t, "Trying to join...")
             elif t > start_tick[i] and t <= fail_tick[i]:
-                for j in np.nonzero(added[t, i])[0]:
+                for j in np.nonzero(added[t - first_tick, i])[0]:
                     yield LogEvent(
                         i, t, f"Node {addr_str(j)} joined at time {t}")
-                for j in np.nonzero(removed[t, i])[0][::-1]:
+                for j in np.nonzero(removed[t - first_tick, i])[0][::-1]:
                     yield LogEvent(
                         i, t, f"Node {addr_str(j)} removed at time {t}")
                 if i == 0 and t % 500 == 0:

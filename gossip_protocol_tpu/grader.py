@@ -55,6 +55,10 @@ class ScenarioGrade:
     def points(self) -> int:
         return self.join_points + self.completeness_points + self.accuracy_points
 
+    @property
+    def max_points(self) -> int:
+        return self.join_max + self.completeness_max + self.accuracy_max
+
 
 def check_join(dbg_path: str, n: int = 10) -> bool:
     """Join completeness (Grader.sh:40-60): either N*N unique
@@ -172,7 +176,24 @@ def main(argv=None) -> int:
                     help="jax platform for the N=10 grading runs (default "
                          "cpu: grading is tiny and must not dial an "
                          "accelerator tunnel)")
+    ap.add_argument("--log", default=None, metavar="DBG_LOG",
+                    help="grade an existing dbg.log instead of running "
+                         "the scenarios (use with --kind)")
+    ap.add_argument("--kind", default="single",
+                    choices=["single", "multi", "drop"],
+                    help="scenario kind of --log")
     args = ap.parse_args(argv)
+
+    if args.log is not None:
+        if args.kind == "single":
+            g = grade_single(args.log)
+        elif args.kind == "multi":
+            g = grade_multi(args.log)
+        else:
+            g = grade_single(args.log, join_pts=15, comp_pts=15, acc_pts=None)
+        print(f"{args.log}: {g.points}/{g.max_points}  {g.detail}")
+        return 0 if g.points == g.max_points else 1
+
     if args.platform:
         import jax
         jax.config.update("jax_platforms", args.platform)

@@ -165,3 +165,40 @@ def state_to_host(state: WorldState) -> dict[str, np.ndarray]:
     """Device state -> plain numpy dict (for checkpointing / debugging)."""
     return {f.name: np.asarray(getattr(state, f.name))
             for f in dataclasses.fields(WorldState)}
+
+
+def state_from_host(host: dict[str, np.ndarray]) -> WorldState:
+    """Inverse of :func:`state_to_host`: rebuild device state.
+
+    The reference has no checkpointing at all (runs are always 0..700,
+    Application.cpp:99); here the whole world is one pytree of arrays,
+    so restore is a straight upload.  Continuation is bit-identical
+    because the clock, the in-flight traffic, and the PRNG key are all
+    part of the state (tests/test_checkpoint.py).
+    """
+    names = {f.name for f in dataclasses.fields(WorldState)}
+    missing = names - host.keys()
+    if missing:
+        raise ValueError(f"checkpoint is missing fields: {sorted(missing)}")
+    extra = host.keys() - names
+    if extra:
+        raise ValueError(
+            f"checkpoint has unknown fields {sorted(extra)} — written by an "
+            "incompatible WorldState schema?")
+    return WorldState(**{k: jnp.asarray(host[k]) for k in names})
+
+
+def save_checkpoint(state: WorldState, path: str) -> None:
+    """Write a mid-run checkpoint (.npz) of the full simulation state.
+
+    The path is used verbatim (np.savez would append ".npz" to an
+    extension-less path, breaking the save/load round trip).
+    """
+    with open(path, "wb") as f:
+        np.savez(f, **state_to_host(state))
+
+
+def load_checkpoint(path: str) -> WorldState:
+    """Load a checkpoint written by :func:`save_checkpoint`."""
+    with np.load(path) as z:
+        return state_from_host({k: z[k] for k in z.files})

@@ -11,6 +11,7 @@ namespace gossip {
 bool Params::LoadConf(const std::string& path) {
   std::ifstream in(path);
   if (!in) return false;
+  bool saw_max_nnb = false;
   std::string line;
   while (std::getline(in, line)) {
     auto colon = line.find(':');
@@ -27,6 +28,7 @@ bool Params::LoadConf(const std::string& path) {
     if (val.empty()) continue;
     if (key == "MAX_NNB") {
       max_nnb = std::atoi(val.c_str());
+      saw_max_nnb = true;
     } else if (key == "SINGLE_FAILURE") {
       single_failure = std::atoi(val.c_str()) != 0;
     } else if (key == "DROP_MSG") {
@@ -34,6 +36,14 @@ bool Params::LoadConf(const std::string& path) {
     } else if (key == "MSG_DROP_PROB") {
       msg_drop_prob = std::atof(val.c_str());
     }
+  }
+  // A readable file that never mentions MAX_NNB is a malformed or
+  // mis-pathed conf (the reference's fscanf would have read garbage,
+  // Params.cpp:22-25); refuse it instead of silently simulating the
+  // 10-peer defaults.
+  if (!saw_max_nnb) {
+    std::fprintf(stderr, "Params: no MAX_NNB key in %s\n", path.c_str());
+    return false;
   }
   return true;
 }

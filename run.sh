@@ -1,0 +1,34 @@
+#!/bin/bash
+# One-command harness: build, run all three shipped scenarios, collect
+# per-scenario dbg logs.  Equivalent of the reference's run.sh:14-26
+# (minus the dead Coursera download/submission plumbing).
+#
+#   ./run.sh                # native C++ engine (fastest)
+#   GOSSIP_BACKEND=jax ./run.sh   # embedded-CPython JAX engine
+#
+# Produces dbg.0.log (singlefailure), dbg.1.log (multifailure),
+# dbg.2.log (msgdropsinglefailure) in the repo root, then prints the
+# grader's verdict for each.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+make
+
+i=0
+kinds=(single multi drop)
+for conf in testcases/singlefailure.conf \
+            testcases/multifailure.conf \
+            testcases/msgdropsinglefailure.conf; do
+  GOSSIP_BACKEND="${GOSSIP_BACKEND:-native}" ./Application "$conf" >/dev/null
+  mv dbg.log "dbg.$i.log"
+  i=$((i + 1))
+done
+
+echo "wrote dbg.0.log dbg.1.log dbg.2.log"
+
+rc=0
+for i in 0 1 2; do
+  python3 -m gossip_protocol_tpu.grader --log "dbg.$i.log" \
+      --kind "${kinds[$i]}" || rc=1
+done
+exit $rc

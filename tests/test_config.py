@@ -46,3 +46,16 @@ def test_start_tick_truncation():
 def test_overrides():
     cfg = scenario_cfg("singlefailure", max_nnb=512, seed=7)
     assert cfg.n == 512 and cfg.seed == 7
+
+
+def test_malformed_conf_rejected(tmp_path):
+    """A readable conf with no MAX_NNB key must be refused, not
+    silently simulated with defaults (native/params.cc agrees)."""
+    import pytest
+
+    p = tmp_path / "junk.conf"
+    p.write_text("SOMETHING: 5\n")
+    with pytest.raises(ValueError, match="MAX_NNB"):
+        SimConfig.from_conf(str(p))
+    # an explicit override supplies the peer count, so the file is fine
+    assert SimConfig.from_conf(str(p), max_nnb=16).n == 16
