@@ -68,6 +68,11 @@ class SimConfig:
     fanout: int = 0
     #: Churn rate per tick (overlay extension; 0 disables).
     churn_rate: float = 0.0
+    #: Churn/rejoin extension (SURVEY.md §5 — the reference never
+    #: re-admits a failed node): failed peers are wiped and re-introduced
+    #: ``rejoin_after`` ticks after their failure, rejoining through the
+    #: normal JOINREQ path.  None disables (reference behavior).
+    rejoin_after: Optional[int] = None
 
     @property
     def n(self) -> int:

@@ -36,6 +36,7 @@ class SimResult:
     cfg: SimConfig
     start_tick: np.ndarray   # i32[N]
     fail_tick: np.ndarray    # i32[N]
+    rejoin_tick: np.ndarray  # i32[N] (NEVER = no churn rejoin)
     added: Optional[np.ndarray]    # bool[T, N, N] (trace mode only)
     removed: Optional[np.ndarray]  # bool[T, N, N]
     sent: np.ndarray         # i32[N, T]
@@ -52,7 +53,8 @@ class SimResult:
         # a tick-0 checkpoint without duplicating mid-run continuations)
         return list(event_stream(self.cfg, self.start_tick, self.fail_tick,
                                  self.added, self.removed,
-                                 first_tick=self.first_tick))
+                                 first_tick=self.first_tick,
+                                 rejoin_tick=self.rejoin_tick))
 
     def grader_view(self) -> dict:
         return grader_view(self.events())
@@ -148,6 +150,7 @@ class Simulation:
             cfg=cfg,
             start_tick=np.asarray(sched.start_tick),
             fail_tick=np.asarray(sched.fail_tick),
+            rejoin_tick=np.asarray(sched.rejoin_tick),
             added=np.concatenate(added, 0),
             removed=np.concatenate(removed, 0),
             sent=np.concatenate(sent, 0).T.copy(),
@@ -185,6 +188,7 @@ class Simulation:
             cfg=cfg,
             start_tick=np.asarray(sched.start_tick),
             fail_tick=np.asarray(sched.fail_tick),
+            rejoin_tick=np.asarray(sched.rejoin_tick),
             added=None, removed=None,
             sent=np.asarray(ev.sent).T.copy(),
             recv=np.asarray(ev.recv).T.copy(),

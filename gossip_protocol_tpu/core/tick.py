@@ -86,6 +86,7 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
     comm = comm or LocalComm(use_pallas)
     n = cfg.n
     t_remove = cfg.t_remove
+    churn = cfg.rejoin_after is not None
     assert n % comm.n_shards == 0, "peer count must divide the mesh axis"
 
     def tick(state: WorldState, sched: Schedule):
@@ -101,6 +102,29 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
         # failed (Application.cpp:130,153).
         proc = (t > sched.start_tick) & ~failed
 
+        # ---- churn extension: wipe rejoining peers -----------------
+        # A peer scheduled to rejoin at tick t is re-initialized like a
+        # fresh nodeStart (initThisNode, MP1Node.cpp:95-113): empty
+        # member list, heartbeat 0, out of group.  It is still failed
+        # while processing tick t (failed_at: fail < t <= rejoin, and
+        # make_schedule enforces rejoin > fail), so it neither consumes
+        # traffic nor gossips this tick, and no other peer reads its
+        # payload rows (in-flight traffic from a failed peer was
+        # already dropped) — the wipe is safe anywhere in the tick.
+        # Statically compiled out for no-churn configs.
+        if churn:
+            rejoining = t == sched.rejoin_tick
+            keep_rows = ~rejoining[row_ids]
+            st_known = state.known & keep_rows[:, None]
+            st_hb = state.hb * keep_rows[:, None]
+            st_ts = state.ts * keep_rows[:, None]
+            st_in_group = state.in_group & ~rejoining
+            st_own_hb = state.own_hb * ~rejoining
+        else:
+            rejoining = jnp.zeros_like(sched.start_tick, bool)
+            st_known, st_hb, st_ts = state.known, state.hb, state.ts
+            st_in_group, st_own_hb = state.in_group, state.own_hb
+
         # ---- phase A: consume in-flight traffic --------------------
         deliver = state.gossip & proc[None, :]           # [rows=s, r] consumed now
         jreq = state.joinreq & proc[INTRODUCER]          # requests the introducer processes
@@ -110,15 +134,15 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
         # ---- checkMessages: GOSSIP piggyback merge -----------------
         # (MP1Node.cpp:244-256; add path MP1Node.cpp:282-301)
         m_hb_all, m_hb_fresh, m_ts_fresh, any_fresh = comm.merge_reduce(
-            recv_from, state.known, state.hb, state.ts, t,
+            recv_from, st_known, st_hb, st_ts, t,
             t_remove=t_remove, block_size=block_size)
 
-        exists = state.known
+        exists = st_known
         # merge into existing entries: adopt a strictly larger heartbeat
         # and refresh the timestamp (MP1Node.cpp:248-251)
-        inc = exists & (m_hb_all > state.hb)
-        hb = jnp.where(inc, m_hb_all, state.hb)
-        ts = jnp.where(inc, t, state.ts)
+        inc = exists & (m_hb_all > st_hb)
+        hb = jnp.where(inc, m_hb_all, st_hb)
+        ts = jnp.where(inc, t, st_ts)
         # add unknown entries if some contribution is fresh
         # (freshness gate at receive time, MP1Node.cpp:294); never self
         # (MP1Node.cpp:290-293).  The entry value mirrors "copy the
@@ -161,7 +185,7 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
         known = known | r_cell
         hb = jnp.where(r_cell, 1, hb)
         ts = jnp.where(r_cell, t, ts)
-        in_group = state.in_group | jrep
+        in_group = st_in_group | jrep
 
         known_after_adds = known
 
@@ -173,7 +197,8 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
         # introducer admits it, gossips its (forever-silent) entry, and
         # everyone removes it TREMOVE ticks later.  Reachable whenever
         # start_tick > fail_tick, i.e. N > 404 with the stock schedule.
-        starting = t == sched.start_tick
+        # A churned peer's rejoin is the same path (a fresh nodeStart).
+        starting = (t == sched.start_tick) | rejoining
         in_group = in_group | (starting & intro_onehot)  # "Starting up group..."
         joinreq_new = starting & ~intro_onehot           # JOINREQ send
 
@@ -182,7 +207,7 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
         # in_group may have been set this very tick (JOINREP processed
         # in checkMessages before the in-group test, MP1Node.cpp:182-190)
         ops = proc & in_group
-        own_hb = state.own_hb + ops.astype(jnp.int32)    # MP1Node.cpp:337
+        own_hb = st_own_hb + ops.astype(jnp.int32)       # MP1Node.cpp:337
         ops_rows = ops[row_ids]
 
         stale = staleness_mask(ops_rows, known, ts, t, t_remove)
@@ -260,7 +285,7 @@ def make_run(cfg: SimConfig, block_size: int = 128, with_events: bool = True,
     """
     comm = LocalComm(use_pallas)
     key = (cfg.n, cfg.t_remove, cfg.total_ticks, block_size, with_events,
-           comm.use_pallas)
+           comm.use_pallas, cfg.rejoin_after is not None)
     if key in _RUN_CACHE:
         return _RUN_CACHE[key]
     tick = make_tick(cfg, block_size, comm=comm)

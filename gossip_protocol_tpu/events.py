@@ -39,7 +39,8 @@ class LogEvent:
 def event_stream(cfg: SimConfig, start_tick: np.ndarray, fail_tick: np.ndarray,
                  added: np.ndarray, removed: np.ndarray,
                  first_tick: int = 0,
-                 include_boot: Optional[bool] = None) -> Iterator[LogEvent]:
+                 include_boot: Optional[bool] = None,
+                 rejoin_tick: Optional[np.ndarray] = None) -> Iterator[LogEvent]:
     """Yield the run's dbg.log events in reference order.
 
     Args:
@@ -57,9 +58,14 @@ def event_stream(cfg: SimConfig, start_tick: np.ndarray, fail_tick: np.ndarray,
                   and a run resumed from a tick-0 checkpoint both get
                   them exactly once, while a zero-length segment or a
                   mid-run continuation never duplicates them.
+      rejoin_tick: i32[N] churn-extension rejoin ticks (NEVER = stays
+                  dead); a rejoining peer logs a fresh nodeStart line
+                  and resumes observing from the next tick.
     """
     n = cfg.n
     t_total = added.shape[0]
+    if rejoin_tick is None:
+        rejoin_tick = np.full(n, NEVER, np.int32)
 
     # "APP" boot lines: one per node at construction time, forward order
     # (Application.cpp:59-69), stamped with tick 0.
@@ -71,13 +77,15 @@ def event_stream(cfg: SimConfig, start_tick: np.ndarray, fail_tick: np.ndarray,
 
     for t in range(first_tick, first_tick + t_total):
         for i in range(n - 1, -1, -1):
-            if t == start_tick[i]:
-                # nodeStart logs (MP1Node.cpp:126-144)
+            if t == start_tick[i] or t == rejoin_tick[i]:
+                # nodeStart logs (MP1Node.cpp:126-144); a churned
+                # peer's rejoin is a fresh nodeStart
                 if i == INTRODUCER:
                     yield LogEvent(i, t, "Starting up group...")
                 else:
                     yield LogEvent(i, t, "Trying to join...")
-            elif t > start_tick[i] and t <= fail_tick[i]:
+            elif (t > start_tick[i] and t <= fail_tick[i]) \
+                    or t > rejoin_tick[i]:
                 for j in np.nonzero(added[t - first_tick, i])[0]:
                     yield LogEvent(
                         i, t, f"Node {addr_str(j)} joined at time {t}")

@@ -48,8 +48,8 @@ def _state_specs(axis: str) -> WorldState:
 
 
 def _sched_specs() -> Schedule:
-    return Schedule(start_tick=P(), fail_tick=P(), drop_active=P(),
-                    drop_prob=P())
+    return Schedule(start_tick=P(), fail_tick=P(), rejoin_tick=P(),
+                    drop_active=P(), drop_prob=P())
 
 
 _SHARDED_CACHE: dict = {}
@@ -67,7 +67,8 @@ def make_sharded_run(cfg: SimConfig, mesh: Mesh, block_size: int = 128,
     n_shards = mesh.devices.size
     comm = RingComm(axis, n_shards, use_pallas)
     key = (cfg.n, cfg.t_remove, cfg.total_ticks, block_size, with_events,
-           n_shards, axis, id(mesh), comm.use_pallas)
+           n_shards, axis, id(mesh), comm.use_pallas,
+           cfg.rejoin_after is not None)
     if key in _SHARDED_CACHE:
         return _SHARDED_CACHE[key]
     tick = make_tick(cfg, block_size, comm=comm)

@@ -81,6 +81,11 @@ class Schedule:
     fail_tick: jax.Array    # i32[N] — bFailed flips at the END of this tick
                             #          (fail() runs after mp1Run, Application.cpp:99-104);
                             #          a huge sentinel means "never fails"
+    rejoin_tick: jax.Array  # i32[N] — churn extension (absent in the reference,
+                            #          SURVEY.md §5): a failed peer is wiped and
+                            #          re-introduced at this tick, rejoining
+                            #          through the normal JOINREQ path; NEVER
+                            #          sentinel = stays dead
     drop_active: jax.Array  # bool[T] — dropmsg flag value during tick t's sends
     drop_prob: jax.Array    # f32 scalar — MSG_DROP_PROB
 
@@ -89,9 +94,11 @@ class Schedule:
 
         ``fail()`` flips ``bFailed`` after tick ``fail_tick`` completes
         (Application.cpp:99-104,181-196), so the flag is observed from
-        tick ``fail_tick + 1`` on.
+        tick ``fail_tick + 1`` on.  A churned peer is failed only for
+        the window ``fail_tick < t <= rejoin_tick`` (its rejoin acts
+        like a fresh ``nodeStart`` at ``rejoin_tick``).
         """
-        return t > self.fail_tick
+        return (t > self.fail_tick) & (t <= self.rejoin_tick)
 
 
 NEVER = np.iinfo(np.int32).max  # sentinel fail_tick for peers that never fail
@@ -126,6 +133,15 @@ def make_schedule(cfg: SimConfig) -> Schedule:
     else:
         r = (int(u * n) % n) // 2
         fail[r: r + n // 2] = cfg.fail_tick
+    rejoin = np.full(n, NEVER, np.int32)
+    if cfg.rejoin_after is not None:
+        if cfg.rejoin_after < 1:
+            # rejoin_tick == fail_tick would collapse the failed window
+            # (failed_at never true) and the rejoin wipe would race the
+            # peer's own tick processing
+            raise ValueError("rejoin_after must be >= 1")
+        failed = fail != NEVER
+        rejoin[failed] = fail[failed] + cfg.rejoin_after
     t = np.arange(cfg.total_ticks, dtype=np.int32)
     drop = np.zeros(cfg.total_ticks, bool)
     if cfg.drop_msg:
@@ -133,6 +149,7 @@ def make_schedule(cfg: SimConfig) -> Schedule:
     return Schedule(
         start_tick=jnp.asarray(start),
         fail_tick=jnp.asarray(fail),
+        rejoin_tick=jnp.asarray(rejoin),
         drop_active=jnp.asarray(drop),
         drop_prob=jnp.float32(cfg.msg_drop_prob),
     )
@@ -185,6 +202,16 @@ def state_from_host(host: dict[str, np.ndarray]) -> WorldState:
         raise ValueError(
             f"checkpoint has unknown fields {sorted(extra)} — written by an "
             "incompatible WorldState schema?")
+    n = np.asarray(host["known"]).shape[0]
+    expect = {"tick": (), "in_group": (n,), "own_hb": (n,),
+              "known": (n, n), "hb": (n, n), "ts": (n, n),
+              "gossip": (n, n), "joinreq": (n,), "joinrep": (n,)}
+    for k, shape in expect.items():
+        got = np.asarray(host[k]).shape
+        if got != shape:
+            raise ValueError(
+                f"checkpoint field {k!r} has shape {got}, expected {shape} "
+                f"(checkpoint written for N={n})")
     return WorldState(**{k: jnp.asarray(host[k]) for k in names})
 
 

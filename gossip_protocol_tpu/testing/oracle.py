@@ -26,6 +26,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..config import INTRODUCER, SimConfig
+from ..state import NEVER
 
 JOINREQ, JOINREP, GOSSIP = 0, 1, 2
 
@@ -56,12 +57,15 @@ class ReferenceOracle:
     """Step-by-step scalar simulation with reference-identical ordering."""
 
     def __init__(self, cfg: SimConfig, start_tick, fail_tick,
-                 gossip_drop=None, joinreq_drop=None, joinrep_drop=None):
+                 gossip_drop=None, joinreq_drop=None, joinrep_drop=None,
+                 rejoin_tick=None):
         self.cfg = cfg
         n = cfg.n
         self.n = n
         self.start_tick = np.asarray(start_tick)
         self.fail_tick = np.asarray(fail_tick)
+        self.rejoin_tick = (np.full(n, NEVER, np.int32)
+                            if rejoin_tick is None else np.asarray(rejoin_tick))
         # drop masks indexed [t, ...]; None = no drops
         self.gossip_drop = gossip_drop
         self.joinreq_drop = joinreq_drop
@@ -79,7 +83,8 @@ class ReferenceOracle:
 
     # --- helpers ----------------------------------------------------
     def failed(self, i) -> bool:
-        return self.t > self.fail_tick[i]
+        """Churn extension: failed only inside (fail, rejoin]."""
+        return self.t > self.fail_tick[i] and self.t <= self.rejoin_tick[i]
 
     def find(self, i, peer):
         for e in self.lists[i]:
@@ -161,14 +166,32 @@ class ReferenceOracle:
         """One global tick: mp1Run phases A+B (Application.cpp:121-163)."""
         t = self.t
         n = self.n
+        # Churn extension: a rejoined peer comes back to an EMPTY
+        # inbox, so traffic addressed to a currently-failed peer that
+        # is scheduled to rejoin is dropped (the batched tick drops all
+        # traffic to failed receivers).  Messages to permanently-failed
+        # peers are left to rot exactly like the reference's buffer
+        # (EmulNet.cpp:151) — removing them would perturb the swap-pop
+        # consumption order for everyone else without any observable
+        # protocol effect.
+        if (self.rejoin_tick != NEVER).any():
+            self.buffer = [m for m in self.buffer
+                           if not (self.failed(m.dst)
+                                   and self.rejoin_tick[m.dst] != NEVER)]
         # phase A: forward order receive
         for i in range(n):
             if t > self.start_tick[i] and not self.failed(i):
                 self.recv_loop(i)
         # phase B: reverse order introduce / nodeLoop
         for i in range(n - 1, -1, -1):
-            if t == self.start_tick[i]:
-                # nodeStart (MP1Node.cpp:67-154)
+            if t == self.start_tick[i] or t == self.rejoin_tick[i]:
+                # nodeStart (MP1Node.cpp:67-154); a churned peer's
+                # rejoin re-initializes like initThisNode first
+                if t == self.rejoin_tick[i]:
+                    self.lists[i] = []
+                    self.queues[i] = []
+                    self.in_group[i] = False
+                    self.own_hb[i] = 0
                 if i == INTRODUCER:
                     self.in_group[i] = True
                 else:

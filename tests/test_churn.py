@@ -1,0 +1,110 @@
+"""Churn/rejoin extension (SURVEY.md §5 — absent in the reference,
+which never re-admits a failed node: no code path resets bFailed,
+MP1Node.cpp:161-168 only clears state at shutdown).
+
+A churned peer is wiped at its rejoin tick and re-enters through the
+normal JOINREQ path.  Checks: full oracle parity with churn enabled,
+rejoin events visible in the log stream, convergence back to complete
+membership, and no permanent false removals.
+"""
+
+import numpy as np
+import pytest
+
+from gossip_protocol_tpu.core.sim import Simulation
+from gossip_protocol_tpu.state import NEVER, make_schedule
+from gossip_protocol_tpu.testing.dropsync import make_drop_masks
+from gossip_protocol_tpu.testing.oracle import ReferenceOracle
+from tests.conftest import scenario_cfg
+
+
+@pytest.mark.parametrize("rejoin_after,drop", [
+    (40, False),   # rejoin well after everyone removed the peer
+    (10, False),   # rejoin while its stale entry still lingers
+    (40, True),    # rejoin under 10% message drop
+])
+def test_churn_oracle_parity(rejoin_after, drop):
+    cfg = scenario_cfg(
+        "msgdropsinglefailure" if drop else "singlefailure",
+        max_nnb=16, seed=2, fail_tick=30, rejoin_after=rejoin_after,
+        total_ticks=160)
+    res = Simulation(cfg).run()
+    sched = make_schedule(cfg)
+    drops = make_drop_masks(cfg, sched) if cfg.drop_msg else (None, None, None)
+    o = ReferenceOracle(cfg, res.start_tick, res.fail_tick, *drops,
+                        rejoin_tick=res.rejoin_tick).run()
+
+    gv = res.grader_view()
+    # joins compared as (tick, observer, subject) triples so a re-join
+    # logged at the wrong tick (or swallowed) cannot hide behind the
+    # pre-failure join of the same pair
+    tick_adds = {(t, i, j) for t, i, j in zip(*np.nonzero(res.added))}
+    assert {(t, i, j) for (t, i, j) in o.events.added} == tick_adds
+    assert {(i, j) for (_, i, j) in o.events.added} == gv["joins"]
+    oracle_removals = {}
+    for (t, i, j) in o.events.removed:
+        oracle_removals.setdefault((i, j), t)
+    if not cfg.drop_msg:
+        assert oracle_removals == gv["removal_ticks"]
+        assert np.array_equal(o.sent, res.sent)
+        assert np.array_equal(o.recv, res.recv)
+    else:
+        assert set(oracle_removals) == set(gv["removal_ticks"])
+    assert np.array_equal(o.known_matrix(), np.asarray(res.final_state.known))
+
+
+def test_churn_rejoin_converges():
+    """After the victim rejoins: it is re-admitted (fresh join events),
+    membership converges back to complete, and nothing is removed
+    after the rejoin settles (no permanent false removals)."""
+    cfg = scenario_cfg("singlefailure", max_nnb=16, seed=2, fail_tick=30,
+                       rejoin_after=40, total_ticks=200)
+    res = Simulation(cfg).run()
+    victim = int(np.flatnonzero(res.fail_tick != NEVER)[0])
+    rejoin_t = int(res.rejoin_tick[victim])
+    assert rejoin_t == 70
+
+    evs = res.events()
+    # the rejoin logs a fresh nodeStart line
+    assert any(e.observer == victim and e.tick == rejoin_t
+               and "Trying to join" in e.text for e in evs)
+    # every survivor removed the victim once (detection of the failure)
+    # and re-admitted it after the rejoin
+    n = cfg.n
+    for obs in range(n):
+        if obs == victim:
+            continue
+        rem = [e.tick for e in evs if e.observer == obs
+               and f"Node {victim + 1}.0.0.0:0 removed" in e.text]
+        readd = [e.tick for e in evs if e.observer == obs
+                 and f"Node {victim + 1}.0.0.0:0 joined" in e.text
+                 and e.tick > rejoin_t]
+        assert rem == [cfg.fail_tick + cfg.t_remove + 1], (obs, rem)
+        assert len(readd) == 1 and readd[0] <= rejoin_t + 4, (obs, readd)
+    # no removals at all after the rejoin settles
+    assert not [e for e in evs
+                if "removed" in e.text and e.tick > rejoin_t + 25]
+    # final membership is complete again
+    known = np.asarray(res.final_state.known)
+    assert (known.sum(1) == n - 1).all()
+    assert bool(np.asarray(res.final_state.in_group).all())
+
+
+def test_quick_rejoin_no_false_removal():
+    """Rejoining before TREMOVE fires means survivors never drop the
+    peer at all: its old entries get refreshed by the new incarnation's
+    gossip, and the member list never shrinks."""
+    cfg = scenario_cfg("singlefailure", max_nnb=16, seed=2, fail_tick=30,
+                       rejoin_after=10, total_ticks=120)
+    res = Simulation(cfg).run()
+    gv = res.grader_view()
+    assert not gv["removal_ticks"], gv["removal_ticks"]
+    known = np.asarray(res.final_state.known)
+    assert (known.sum(1) == cfg.n - 1).all()
+
+
+def test_rejoin_after_zero_rejected():
+    """rejoin_tick == fail_tick would collapse the failed window."""
+    cfg = scenario_cfg("singlefailure", max_nnb=16, rejoin_after=0)
+    with pytest.raises(ValueError, match="rejoin_after"):
+        make_schedule(cfg)
