@@ -27,7 +27,7 @@ __all__ = [
     "SINGLE_FAILURE", "MULTI_FAILURE", "MSG_DROP_SINGLE_FAILURE",
     "WorldState", "Schedule", "init_state", "make_schedule",
     "state_to_host", "state_from_host", "save_checkpoint", "load_checkpoint",
-    "Simulation", "run_scenario",
+    "Simulation", "run_scenario", "OverlaySimulation",
 ]
 
 
@@ -36,4 +36,7 @@ def __getattr__(name):
     if name in ("Simulation", "run_scenario"):
         from .core import sim
         return getattr(sim, name)
+    if name == "OverlaySimulation":
+        from .models.overlay import OverlaySimulation
+        return OverlaySimulation
     raise AttributeError(name)

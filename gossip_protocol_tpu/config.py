@@ -64,8 +64,16 @@ class SimConfig:
     #: all-pairs full-list heartbeating; "overlay" is the bounded
     #: partial-view family for very large N (BASELINE.json 65k/1M configs).
     model: str = "full_view"
-    #: Overlay fanout (only used by model="overlay"); 0 = auto (~log2 N).
+    #: Overlay exchange fanout (only used by model="overlay");
+    #: 0 = auto (~log2(N)/2 + 2, see models/overlay.py resolved_dims).
     fanout: int = 0
+    #: Overlay view capacity K (slots per node; models/overlay.py).
+    #: 0 = auto (~4*log2 N, capped at 64).  Right-sizing matters: too
+    #: large a view at small N starves slots of merge candidates.
+    overlay_view: int = 0
+    #: Overlay payload sample L: view slots carried per message
+    #: (rotating window; full view every K/L ticks).  0 = auto (K/2).
+    overlay_sample: int = 0
     #: Churn rate per tick (overlay extension; 0 disables).
     churn_rate: float = 0.0
     #: Churn/rejoin extension (SURVEY.md §5 — the reference never

@@ -1,0 +1,20 @@
+"""Model families.
+
+* dense full-view (``core/tick.py``) — the reference-faithful protocol,
+  O(N²) state, exact parity with the C++ reference's semantics.
+* bounded partial-view overlay (``models/overlay.py``) — the large-N
+  scaling model (BASELINE 65k/1M configs), O(N·K) state, dense-algebra
+  tick (XOR exchange + hash-slot scatter-free merge).
+"""
+
+from .overlay import (OverlayMetrics, OverlayResult, OverlaySchedule,
+                      OverlaySimulation, OverlayState, init_overlay_state,
+                      make_overlay_run, make_overlay_schedule,
+                      make_overlay_tick, resolved_dims)
+
+__all__ = [
+    "OverlayMetrics", "OverlayResult", "OverlaySchedule",
+    "OverlaySimulation", "OverlayState", "init_overlay_state",
+    "make_overlay_run", "make_overlay_schedule", "make_overlay_tick",
+    "resolved_dims",
+]

@@ -1,0 +1,645 @@
+"""Bounded partial-view overlay: the large-N scaling model.
+
+The reference's protocol is full-view: every node stores an entry for
+every other node and gossips its entire list to everyone each tick
+(MP1Node.cpp:350-361), which is O(N²) state and O(N³) merge work — and
+it hard-caps at N<=10 (MP1Node.cpp:245) / N<=1000 (EmulNet.h:10).  The
+dense model in ``core/tick.py`` removes the caps but keeps O(N²) state,
+so BASELINE's 65k and 1M peer configs are unreachable by construction.
+This module is the scaling answer: a **bounded partial-view** membership
+protocol with O(N·K) state and O(N·F·L) work per tick.
+
+Design: TPU-first, and specifically **gather/scatter/sort-free** — on
+TPU those lower to serialized index loops (measured ~75M indices/s,
+hundreds of ms per tick at 65k), so every phase here is dense algebra:
+
+* **Dissemination = XOR partner exchange.**  At tick t every in-group
+  node exchanges its payload with the F partners ``i ^ m_f(t)``, where
+  the nonzero masks ``m_f(t)`` are counter-hashed fresh each tick —
+  a new random F-regular graph per tick over the 2^b address space
+  (the Erdős–Rényi-flavored fanout of the BASELINE configs), which
+  mixes like an expander.  Applying ``x[i ^ m]`` to the whole payload
+  matrix is two small permutation **matmuls** (the XOR factors
+  bitwise across a HI×LO index split), exact in f32 and riding the
+  MXU — no gather anywhere.  Payloads carry a rotating L-slot window
+  of the sender's view plus its self-entry, frozen at the send tick
+  (= the carried state, the dense model's zero-copy trick).
+* **View = per-receiver hash-slotted table.**  Node ``r`` can hold an
+  entry for peer ``j`` only in slot ``h(r, j) = mix32(r, j) % K``
+  (utils/hash32.py).  Collisions contend; the winner of a slot is the
+  entry with the largest packed uint32 key — freshness band first,
+  then an **epoch-rotated per-receiver tiebreak** — evaluated as a
+  dense (N, K, L+1) masked max per partner (K and L are small static
+  constants, so the "scatter" is a masked reduction).  The rotation is
+  load-bearing: a sticky max-(ts, id) key freezes view composition,
+  freshness waves stop reaching peripheral holders, and live entries
+  age out as false removals.  With rotation, views continuously
+  reshuffle (the TPU-shaped analog of Cyclon-style view exchange).
+* **Freshness is the priority.**  A live node stamps its own entry
+  ``(id, own_hb, now)`` into every payload; the banded max-merge
+  propagates the freshest observation along exchange paths, so an
+  entry's ``ts`` is the newest time anyone in the path cone saw the
+  subject alive.  Failure detection is the reference's staleness rule
+  (now - ts >= TREMOVE, MP1Node.cpp:339-348).
+* **Schedules are closed-form.**  Start ramp, scripted failures,
+  churn membership, churn fail/rejoin ticks, and drop decisions are
+  all pure counter-hash functions of (seed, id, tick) — no (N,)
+  schedule arrays to look up by id on device (an id-indexed lookup is
+  a gather), and the numpy oracle (testing/overlay_oracle.py) replays
+  them bit-exactly.
+
+Accuracy semantics at scale: per-holder staleness removals are
+*expected background churn* in a bounded partial view (an entry's
+refresh is arrival-limited); the guarantees that matter are global —
+every live member stays covered by the union of views, failed peers
+are purged from every view within the detection horizon, and churned
+peers re-enter through the normal JOINREQ path.  The reference-faithful
+per-observer guarantees live in the dense model.
+
+Deliberate divergences from the reference protocol (this is the
+framework's scaling extension): receivers adopt the freshest (ts, hb)
+observation instead of the increment-on-direct-gossip quirk
+(MP1Node.cpp:236-239); views are bounded, so entries can be evicted by
+slot contention; dissemination follows the XOR schedule rather than
+"send to everyone I know"; payloads are sampled windows, not full
+lists.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from fractions import Fraction
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..config import INTRODUCER, SimConfig
+from ..state import NEVER
+from ..utils.hash32 import mix32, threshold32
+
+#: id field width in the packed priority key: ids + 1 <= 2^21 - 1, and
+#: the XOR exchange needs a power-of-two peer count, so the largest
+#: supported group is N = 2^20 = 1,048,576 — the BASELINE 1M-peer
+#: config exactly.
+ID_BITS = 21
+ID_MASK = (1 << ID_BITS) - 1
+
+#: freshness band width (ticks) and tiebreak rotation period
+BAND = 4
+EPOCH = 4
+_TIE_BITS = 8
+
+# salts for the independent counter-hash streams
+_SALT_MASK = 1
+_SALT_GOSSIP_DROP = 2
+_SALT_JOINREQ_DROP = 3
+_SALT_JOINREP_DROP = 4
+_SALT_CHURN = 5
+_SALT_CHURN_TICK = 6
+
+
+@struct.dataclass
+class OverlayState:
+    """World state: O(N·K) tables plus O(N·F) in-flight send flags."""
+
+    tick: jax.Array        # i32 scalar
+    ids: jax.Array         # i32[N, K] — entry subject id, -1 = empty slot
+    hb: jax.Array          # i32[N, K] — heartbeat of the entry
+    ts: jax.Array          # i32[N, K] — freshest observation time
+    in_group: jax.Array    # bool[N]
+    own_hb: jax.Array      # i32[N]
+    send_flags: jax.Array  # bool[N, F] — node gossiped on exchange slot f
+                           #   last tick (in-flight traffic marker)
+    joinreq: jax.Array     # bool[N] — JOINREQ to the introducer in flight
+    joinrep: jax.Array     # bool[N] — JOINREP back to the joiner in flight
+
+
+@struct.dataclass
+class OverlaySchedule:
+    """Closed-form schedule: scalars only, evaluated per (id, tick).
+
+    ``fail_of``/``rejoin_of``/``start_of`` are pure functions usable on
+    whole id arrays — the device never indexes a schedule table.
+    With ``churn_thr > 0`` continuous churn replaces the scripted
+    failure (the BASELINE 65k/20%-churn shape); otherwise the scripted
+    single/multi failure interval applies.
+    """
+
+    seed: jax.Array         # u32 scalar
+    step_num: jax.Array     # i32 — start ramp: start(i) = i*num//den
+    step_den: jax.Array     # i32
+    victim_lo: jax.Array    # i32 — scripted failure interval [lo, hi)
+    victim_hi: jax.Array    # i32
+    fail_tick: jax.Array    # i32 — scripted failure tick
+    rejoin_after: jax.Array  # i32 — NEVER disables rejoin
+    churn_thr: jax.Array    # u32 — churn membership threshold (0 = off)
+    churn_lo: jax.Array     # i32 — churn fail ticks in [lo, lo+span)
+    churn_span: jax.Array   # i32
+    churn_after: jax.Array  # i32 — churn rejoin delay
+    drop_on: jax.Array      # bool — drop window configured
+    drop_open: jax.Array    # i32 — droppable sends: open < t <= close
+    drop_close: jax.Array   # i32
+    drop_thr: jax.Array     # u32 — per-message Bernoulli threshold
+
+    def start_of(self, i):
+        return (i * self.step_num) // self.step_den
+
+    def _churned(self, i):
+        iu = i.astype(jnp.uint32) if hasattr(i, "astype") else np.uint32(i)
+        sel = mix32(self.seed, iu, np.uint32(_SALT_CHURN)) < self.churn_thr
+        return sel & (i != INTRODUCER)
+
+    def fail_of(self, i):
+        iu = i.astype(jnp.uint32) if hasattr(i, "astype") else np.uint32(i)
+        churn_fail = self.churn_lo + (
+            mix32(self.seed, iu, np.uint32(_SALT_CHURN_TICK))
+            % self.churn_span.astype(jnp.uint32)).astype(jnp.int32)
+        scripted = jnp.where((i >= self.victim_lo) & (i < self.victim_hi),
+                             self.fail_tick, NEVER)
+        return jnp.where(self.churn_thr > 0,
+                         jnp.where(self._churned(i), churn_fail, NEVER),
+                         scripted)
+
+    def rejoin_of(self, i):
+        fail = self.fail_of(i)
+        after = jnp.where(self.churn_thr > 0, self.churn_after,
+                          self.rejoin_after)
+        return jnp.where((fail != NEVER) & (after != NEVER),
+                         fail + after, NEVER)
+
+    def drop_active(self, t):
+        return self.drop_on & (t > self.drop_open) & (t <= self.drop_close)
+
+
+def make_overlay_schedule(cfg: SimConfig) -> OverlaySchedule:
+    from ..utils.prng import fail_schedule_uniform
+
+    n = cfg.n
+    frac = Fraction(cfg.step_rate).limit_denominator(1 << 15)
+    if cfg.churn_rate > 0:
+        # the churn window must not overlap the start ramp: a churned
+        # peer whose fail tick precedes its start would be introduced
+        # while failed (a posthumous join — reference-faithful in the
+        # dense model, but it would suspend the overlay's victim-purge
+        # guarantee).  Require the ramp to finish before churn opens.
+        last_start = (n - 1) * frac.numerator // max(frac.denominator, 1)
+        churn_lo = cfg.total_ticks // 4
+        if last_start >= churn_lo:
+            raise ValueError(
+                f"start ramp ends at t={last_start} but churn opens at "
+                f"t={churn_lo}; lower step_rate (e.g. {churn_lo / (2 * n)}) "
+                "or lengthen the run")
+    victim_lo, victim_hi = 0, 0
+    if cfg.churn_rate <= 0:
+        u = fail_schedule_uniform(cfg.seed)
+        if cfg.single_failure:
+            victim_lo = int(u * n) % n
+            victim_hi = victim_lo + 1
+        else:
+            victim_lo = (int(u * n) % n) // 2
+            victim_hi = victim_lo + n // 2
+    return OverlaySchedule(
+        seed=jnp.uint32(cfg.seed & 0xFFFFFFFF),
+        step_num=jnp.int32(frac.numerator),
+        step_den=jnp.int32(max(frac.denominator, 1)),
+        victim_lo=jnp.int32(victim_lo),
+        victim_hi=jnp.int32(victim_hi),
+        fail_tick=jnp.int32(cfg.fail_tick),
+        rejoin_after=jnp.int32(cfg.rejoin_after
+                               if cfg.rejoin_after is not None else NEVER),
+        churn_thr=jnp.uint32(threshold32(cfg.churn_rate)
+                             if cfg.churn_rate > 0 else 0),
+        churn_lo=jnp.int32(cfg.total_ticks // 4),
+        churn_span=jnp.int32(max(cfg.total_ticks // 2, 1)),
+        churn_after=jnp.int32(cfg.rejoin_after
+                              if cfg.rejoin_after is not None else 40),
+        drop_on=jnp.asarray(bool(cfg.drop_msg)),
+        drop_open=jnp.int32(cfg.drop_open_tick),
+        drop_close=jnp.int32(cfg.drop_close_tick),
+        drop_thr=jnp.uint32(threshold32(cfg.msg_drop_prob)),
+    )
+
+
+@struct.dataclass
+class OverlayMetrics:
+    """Per-tick scalar counters (events at 65k+ cannot be dense masks)."""
+
+    in_group: jax.Array       # i32 — nodes currently in the group
+    view_slots: jax.Array     # i32 — total occupied view slots
+    adds: jax.Array           # i32 — slots that changed to a new subject
+    removals: jax.Array       # i32 — staleness removals this tick
+    false_removals: jax.Array  # i32 — removals naming a live subject
+    #   (expected background churn in a bounded partial view — see
+    #   module docstring; the hard guarantee is live coverage)
+    victim_slots: jax.Array   # i32 — slots still naming a failed subject
+    live_uncovered: jax.Array  # i32 — live members in NO view (-1 when
+    #   not tracked: the histogram needs a scatter, so it is computed
+    #   only at small N; large-N coverage is checked on the final state)
+    sent: jax.Array           # i32 — messages sent (after drop)
+    recv: jax.Array           # i32 — messages consumed
+
+
+#: track the live-coverage histogram on device only below this N
+COVERAGE_N_LIMIT = 4096
+
+
+def resolved_dims(cfg: SimConfig):
+    """(K, L, F): view slots, payload window, exchange fanout.
+
+    Auto sizing targets a per-slot candidate supply F*(L+1)/K of a few
+    per tick (so slot refresh/eviction outpaces the TREMOVE horizon
+    even in the hash-popularity tail) with K ~ 4*log2 N for
+    connectivity, capped at 64.
+    """
+    n = cfg.n
+    b = int(math.ceil(math.log2(max(n, 4))))
+    f = cfg.fanout if cfg.fanout > 0 else max(2, b // 2 + 2)
+    k = cfg.overlay_view if cfg.overlay_view > 0 \
+        else min(64, max(16, 8 * ((b + 1) // 2)))
+    l = min(cfg.overlay_sample, k) if cfg.overlay_sample > 0 \
+        else max(4, k // 2)
+    return k, l, f
+
+
+def _split_hi_lo(n: int):
+    b = n.bit_length() - 1
+    hi = 1 << ((b + 1) // 2)
+    return hi, n // hi
+
+
+def init_overlay_state(cfg: SimConfig) -> OverlayState:
+    n = cfg.n
+    k, l, f = resolved_dims(cfg)
+    return OverlayState(
+        tick=jnp.int32(0),
+        ids=jnp.full((n, k), -1, jnp.int32),
+        hb=jnp.zeros((n, k), jnp.int32),
+        ts=jnp.zeros((n, k), jnp.int32),
+        in_group=jnp.zeros(n, bool),
+        own_hb=jnp.zeros(n, jnp.int32),
+        send_flags=jnp.zeros((n, f), bool),
+        joinreq=jnp.zeros(n, bool),
+        joinrep=jnp.zeros(n, bool),
+    )
+
+
+def exchange_mask(seed, t, fi, n):
+    """Nonzero XOR mask of exchange slot ``fi`` at tick ``t`` (traced)."""
+    tu = t.astype(jnp.uint32) if hasattr(t, "astype") else np.uint32(t)
+    m = mix32(seed, tu, np.uint32(fi), np.uint32(_SALT_MASK))
+    return (m % np.uint32(n - 1)).astype(jnp.int32) + 1
+
+
+def _pack_key(seed, t, rows_u, ids, ts):
+    """uint32 slot-priority key: freshness band | rotated tie | id+1.
+
+    band (3b): fresher BAND-quantized age wins outright.
+    tie (9b):  mix32(seed, epoch, receiver, id) — re-rolled every EPOCH
+               ticks, per receiver, so slot winners rotate.
+    id+1 (20b): deterministic final tiebreak; nonzero (0 = empty).
+    """
+    age = jnp.clip(t - ts, 0, 8 * BAND - 1)
+    band = (jnp.uint32(7) - (age // BAND).astype(jnp.uint32)) \
+        << (ID_BITS + _TIE_BITS)
+    epoch = (t // EPOCH).astype(jnp.uint32)
+    tie = (mix32(seed, epoch, rows_u, ids.astype(jnp.uint32))
+           >> (32 - _TIE_BITS)) << ID_BITS
+    return band | tie | (ids + 1).astype(jnp.uint32)
+
+
+def make_overlay_tick(cfg: SimConfig):
+    """Build ``tick(state, sched) -> (state', OverlayMetrics)``."""
+    n = cfg.n
+    k, l, f = resolved_dims(cfg)
+    t_remove = cfg.t_remove
+    assert n & (n - 1) == 0, "overlay peer count must be a power of two " \
+        "(XOR partner exchange)"
+    assert n + 1 < (1 << ID_BITS), \
+        f"overlay supports N <= {1 << (ID_BITS - 1)}"
+    hi, lo = _split_hi_lo(n)
+    with_coverage = n <= COVERAGE_N_LIMIT
+
+    rows = jnp.arange(n, dtype=jnp.int32)
+    rows_u = rows.astype(jnp.uint32)
+    intro_onehot = rows == INTRODUCER
+    kk = jnp.arange(k, dtype=jnp.int32)
+    io_hi = jnp.arange(hi, dtype=jnp.int32)
+    io_lo = jnp.arange(lo, dtype=jnp.int32)
+
+    def xor_perm(x, mask):
+        """x[i ^ mask] for every row i — two permutation matmuls.
+
+        Exactness matters: payload values go up to N-1 and HIGHEST
+        precision keeps the f32 contraction exact (the TPU default
+        truncates matmul inputs to bf16, which rounds ids >= 2^16 —
+        e.g. 65535 -> 65536 — and corrupts the tables)."""
+        mh, ml = mask // lo, mask % lo
+        ph = (io_hi[:, None] == (io_hi[None, :] ^ mh)).astype(jnp.float32)
+        pl = (io_lo[:, None] == (io_lo[None, :] ^ ml)).astype(jnp.float32)
+        y = x.reshape(hi, lo, x.shape[-1])
+        y = jnp.einsum("ab,bld->ald", ph, y,
+                       precision=jax.lax.Precision.HIGHEST,
+                       preferred_element_type=jnp.float32)
+        y = jnp.einsum("lb,abd->ald", pl, y,
+                       precision=jax.lax.Precision.HIGHEST,
+                       preferred_element_type=jnp.float32)
+        return y.reshape(x.shape)
+
+    def tick(state: OverlayState, sched: OverlaySchedule):
+        t = state.tick
+        tu = t.astype(jnp.uint32)
+        seed = sched.seed
+        start = sched.start_of(rows)
+        fail = sched.fail_of(rows)
+        rejoin = sched.rejoin_of(rows)
+        failed = (t > fail) & (t <= rejoin)
+        proc = (t > start) & ~failed
+
+        # ---- churn wipe (same semantics as core/tick.py) -----------
+        rejoining = t == rejoin
+        keep = ~rejoining
+        ids0 = jnp.where(keep[:, None], state.ids, -1)
+        hb0 = state.hb * keep[:, None]
+        ts0 = state.ts * keep[:, None]
+        in_group0 = state.in_group & keep
+        own_hb0 = state.own_hb * keep
+
+        # ---- payload of the send tick t-1 --------------------------
+        # rotating L-slot window (covers the view every K/L ticks) +
+        # the sender's self-entry; all from carried state = frozen at
+        # the end of tick t-1
+        off = (((t - 1) * l) % k + k) % k
+        idsw = jax.lax.dynamic_slice(
+            jnp.concatenate([ids0, ids0], 1), (0, off), (n, l))
+        hbw = jax.lax.dynamic_slice(
+            jnp.concatenate([hb0, hb0], 1), (0, off), (n, l))
+        tsw = jax.lax.dynamic_slice(
+            jnp.concatenate([ts0, ts0], 1), (0, off), (n, l))
+        payload = jnp.concatenate([
+            idsw.astype(jnp.float32),
+            hbw.astype(jnp.float32),
+            tsw.astype(jnp.float32),
+            own_hb0.astype(jnp.float32)[:, None],
+        ], 1)   # (N, 3L+1); the per-slot in-flight flag is appended below
+
+        # ---- merge phase: one dense (N, K, L+1) pass per partner ---
+        cur_key = jnp.where(ids0 >= 0,
+                            _pack_key(seed, t, rows_u[:, None], ids0, ts0),
+                            0)
+        keymax = cur_key
+        ts_acc = jnp.where(ids0 >= 0, ts0, 0)
+        hb_acc = jnp.where(ids0 >= 0, hb0, 0)
+        recv_cnt = jnp.zeros((), jnp.int32)
+
+        for fi in range(f):
+            mask = exchange_mask(seed, t - 1, fi, n)
+            flag_col = state.send_flags[:, fi].astype(jnp.float32)[:, None]
+            q = xor_perm(
+                jnp.concatenate([payload[:, :3 * l + 1], flag_col], 1), mask)
+            partner = rows ^ mask
+            c_id = jnp.concatenate(
+                [q[:, :l].astype(jnp.int32), partner[:, None]], 1)
+            c_hb = jnp.concatenate(
+                [q[:, l:2 * l].astype(jnp.int32),
+                 q[:, 3 * l].astype(jnp.int32)[:, None]], 1)
+            c_ts = jnp.concatenate(
+                [q[:, 2 * l:3 * l].astype(jnp.int32),
+                 jnp.broadcast_to(t - 1, (n, 1))], 1)
+            sent_flag = q[:, 3 * l + 1] > 0.5
+            valid = sent_flag[:, None] & proc[:, None] & (c_id >= 0) \
+                & (t - c_ts < t_remove) & (c_id != rows[:, None])
+            recv_cnt += (sent_flag & proc).sum().astype(jnp.int32)
+
+            slot = (mix32(seed, rows_u[:, None],
+                          c_id.astype(jnp.uint32)) % k).astype(jnp.int32)
+            key = jnp.where(valid,
+                            _pack_key(seed, t, rows_u[:, None], c_id, c_ts),
+                            0)
+            match = slot[:, None, :] == kk[None, :, None]    # (N, K, L+1)
+            kf = (match * key[:, None, :]).max(2)
+            sel = match & (key[:, None, :] == kf[:, :, None]) & (kf > 0)[:, :, None]
+            ts_f = jnp.where(sel, c_ts[:, None, :], 0).max(2)
+            hb_f = jnp.where(sel, c_hb[:, None, :], 0).max(2)
+            new_max = jnp.maximum(keymax, kf)
+            same = kf == new_max
+            was = keymax == new_max
+            ts_acc = jnp.where(same, jnp.maximum(ts_f, jnp.where(was, ts_acc, 0)),
+                               ts_acc)
+            hb_acc = jnp.where(same, jnp.maximum(hb_f, jnp.where(was, hb_acc, 0)),
+                               hb_acc)
+            keymax = new_max
+
+        # ---- JOINREP consumption (introducer's payload broadcast) --
+        jrep = state.joinrep & proc
+        j_id = jnp.concatenate([idsw[INTRODUCER],
+                                jnp.array([INTRODUCER], jnp.int32)])
+        j_hb = jnp.concatenate([hbw[INTRODUCER], own_hb0[INTRODUCER][None]])
+        j_ts = jnp.concatenate([tsw[INTRODUCER], (t - 1)[None]])
+        jc_id = jnp.broadcast_to(j_id, (n, l + 1))
+        jc_ts = jnp.broadcast_to(j_ts, (n, l + 1))
+        jc_hb = jnp.broadcast_to(j_hb, (n, l + 1))
+        j_valid = jrep[:, None] & (jc_id >= 0) & (t - jc_ts < t_remove) \
+            & (jc_id != rows[:, None])
+        slot = (mix32(seed, rows_u[:, None],
+                      jc_id.astype(jnp.uint32)) % k).astype(jnp.int32)
+        key = jnp.where(j_valid,
+                        _pack_key(seed, t, rows_u[:, None], jc_id, jc_ts), 0)
+        match = slot[:, None, :] == kk[None, :, None]
+        kf = (match * key[:, None, :]).max(2)
+        sel = match & (key[:, None, :] == kf[:, :, None]) & (kf > 0)[:, :, None]
+        ts_f = jnp.where(sel, jc_ts[:, None, :], 0).max(2)
+        hb_f = jnp.where(sel, jc_hb[:, None, :], 0).max(2)
+        new_max = jnp.maximum(keymax, kf)
+        same = kf == new_max
+        was = keymax == new_max
+        ts_acc = jnp.where(same, jnp.maximum(ts_f, jnp.where(was, ts_acc, 0)),
+                           ts_acc)
+        hb_acc = jnp.where(same, jnp.maximum(hb_f, jnp.where(was, hb_acc, 0)),
+                           hb_acc)
+        keymax = new_max
+        in_group = in_group0 | jrep
+
+        # ---- JOINREQ at the introducer -----------------------------
+        # requester entries (j, hb=1, ts=t) merged into row 0 as a
+        # dense (K, N) masked max (addMember, MP1Node.cpp:265-280)
+        jreq = state.joinreq & proc[INTRODUCER]
+        q_slot = (mix32(seed, jnp.uint32(INTRODUCER), rows_u) % k) \
+            .astype(jnp.int32)
+        q_key = jnp.where(jreq & ~intro_onehot,
+                          _pack_key(seed, t, jnp.uint32(INTRODUCER), rows,
+                                    jnp.broadcast_to(t, (n,))), 0)
+        q_match = q_slot[None, :] == kk[:, None]             # (K, N)
+        q_kf = (q_match * q_key[None, :]).max(1)             # (K,)
+        q_sel = q_match & (q_key[None, :] == q_kf[:, None]) & (q_kf > 0)[:, None]
+        q_ts = jnp.where(q_sel, t, 0).max(1)
+        q_hb = jnp.where(q_sel, 1, 0).max(1)
+        row0_new = jnp.maximum(keymax[INTRODUCER], q_kf)
+        same0 = q_kf == row0_new
+        was0 = keymax[INTRODUCER] == row0_new
+        ts0_row = jnp.where(same0,
+                            jnp.maximum(q_ts, jnp.where(was0, ts_acc[INTRODUCER], 0)),
+                            ts_acc[INTRODUCER])
+        hb0_row = jnp.where(same0,
+                            jnp.maximum(q_hb, jnp.where(was0, hb_acc[INTRODUCER], 0)),
+                            hb_acc[INTRODUCER])
+        keymax = keymax.at[INTRODUCER].set(row0_new)
+        ts_acc = ts_acc.at[INTRODUCER].set(ts0_row)
+        hb_acc = hb_acc.at[INTRODUCER].set(hb0_row)
+        recv_cnt += jrep.sum().astype(jnp.int32) + jreq.sum().astype(jnp.int32)
+
+        ids1 = jnp.where(keymax > 0,
+                         (keymax & ID_MASK).astype(jnp.int32) - 1, -1)
+        ts1 = jnp.where(keymax > 0, ts_acc, 0)
+        hb1 = jnp.where(keymax > 0, hb_acc, 0)
+
+        # ---- nodeStart / rejoin ------------------------------------
+        starting = (t == start) | rejoining
+        in_group = in_group | (starting & intro_onehot)
+        joinreq_new = starting & ~intro_onehot
+        active = sched.drop_active(t)
+        qdrop = mix32(seed, tu, rows_u, np.uint32(_SALT_JOINREQ_DROP)) \
+            < sched.drop_thr
+        pdrop = mix32(seed, tu, rows_u, np.uint32(_SALT_JOINREP_DROP)) \
+            < sched.drop_thr
+        joinreq_sent = joinreq_new & ~(active & qdrop)
+        joinrep_sent = jreq & ~(active & pdrop)      # introducer's replies
+
+        # ---- detection (nodeLoopOps analog) ------------------------
+        ops = proc & in_group
+        own_hb = own_hb0 + ops.astype(jnp.int32)
+        stale = (ids1 >= 0) & (t - ts1 >= t_remove) & ops[:, None]
+        subj = jnp.clip(ids1, 0)
+        subj_fail = sched.fail_of(subj)
+        subj_failed = (t > subj_fail) & (t <= sched.rejoin_of(subj))
+        removals = stale.sum().astype(jnp.int32)
+        false_removals = (stale & ~subj_failed).sum().astype(jnp.int32)
+        ids2 = jnp.where(stale, -1, ids1)
+        hb2 = jnp.where(stale, 0, hb1)
+        ts2 = jnp.where(stale, 0, ts1)
+
+        # ---- dissemination: set the in-flight flags ----------------
+        fis = jnp.arange(f, dtype=jnp.uint32)
+        gdrop = mix32(seed, tu, rows_u[:, None], fis[None, :],
+                      np.uint32(_SALT_GOSSIP_DROP)) < sched.drop_thr
+        send_flags = ops[:, None] & ~(active & gdrop)
+        sent = send_flags.sum().astype(jnp.int32) \
+            + joinreq_sent.sum().astype(jnp.int32) \
+            + joinrep_sent.sum().astype(jnp.int32)
+
+        live_hold = ~proc & ~failed
+        joinreq_next = joinreq_sent | (state.joinreq
+                                       & ~proc[INTRODUCER] & ~failed[INTRODUCER])
+        joinrep_next = joinrep_sent | (state.joinrep & live_hold)
+
+        live_member = in_group & ~failed & ~intro_onehot
+        if with_coverage:
+            covered = jnp.zeros(n, bool).at[jnp.clip(ids2, 0).reshape(-1)] \
+                .max((ids2 >= 0).reshape(-1))
+            live_uncovered = (live_member & ~covered).sum().astype(jnp.int32)
+        else:
+            live_uncovered = jnp.int32(-1)
+
+        metrics = OverlayMetrics(
+            in_group=in_group.sum().astype(jnp.int32),
+            view_slots=(ids2 >= 0).sum().astype(jnp.int32),
+            adds=((ids1 != ids0) & (ids1 >= 0)).sum().astype(jnp.int32),
+            removals=removals,
+            false_removals=false_removals,
+            victim_slots=((ids2 >= 0) & subj_failed & ~stale).sum().astype(jnp.int32),
+            live_uncovered=live_uncovered,
+            sent=sent,
+            recv=recv_cnt,
+        )
+        new_state = OverlayState(
+            tick=t + 1,
+            ids=ids2, hb=hb2, ts=ts2,
+            in_group=in_group, own_hb=own_hb,
+            send_flags=send_flags,
+            joinreq=joinreq_next, joinrep=joinrep_next,
+        )
+        return new_state, metrics
+
+    return tick
+
+
+_OVERLAY_RUN_CACHE: dict = {}
+
+
+def make_overlay_run(cfg: SimConfig):
+    """Whole-run ``lax.scan``: ``run(state, sched) -> (final, metrics[T])``."""
+    key = (cfg.n, cfg.t_remove, cfg.total_ticks, resolved_dims(cfg))
+    if key in _OVERLAY_RUN_CACHE:
+        return _OVERLAY_RUN_CACHE[key]
+    tick = make_overlay_tick(cfg)
+
+    @jax.jit
+    def run(state: OverlayState, sched: OverlaySchedule):
+        def step(carry, _):
+            return tick(carry, sched)
+        return jax.lax.scan(step, state, None, length=cfg.total_ticks)
+
+    _OVERLAY_RUN_CACHE[key] = run
+    return run
+
+
+@dataclasses.dataclass
+class OverlayResult:
+    cfg: SimConfig
+    sched: OverlaySchedule
+    final_state: OverlayState
+    metrics: OverlayMetrics      # numpy arrays, each [T]
+    wall_seconds: float
+
+    @property
+    def node_ticks_per_second(self) -> float:
+        return self.cfg.n * self.cfg.total_ticks / self.wall_seconds
+
+    def final_coverage(self):
+        """(live_uncovered_count, victim_entries_left) from the final
+        tables, computed on host — the large-N stand-in for the
+        per-tick coverage histogram."""
+        ids = np.asarray(self.final_state.ids)
+        n = self.cfg.n
+        t_end = self.cfg.total_ticks
+        if ids.max() >= n:
+            raise AssertionError(
+                f"corrupt view table: id {ids.max()} >= N={n}")
+        present = np.zeros(n, bool)
+        present[ids[ids >= 0]] = True
+        i = np.arange(n)
+        fail = np.asarray(self.sched.fail_of(jnp.asarray(i)))
+        rejoin = np.asarray(self.sched.rejoin_of(jnp.asarray(i)))
+        failed = (t_end > fail) & (t_end <= rejoin)
+        in_group = np.asarray(self.final_state.in_group)
+        live = in_group & ~failed & (i != INTRODUCER)
+        flat = ids[ids >= 0]
+        victim_left = int(((t_end > fail[flat]) & (t_end <= rejoin[flat])).sum())
+        return int((live & ~present).sum()), victim_left
+
+
+class OverlaySimulation:
+    """Orchestrator for cfg.model == "overlay" runs (metrics mode)."""
+
+    def __init__(self, cfg: SimConfig):
+        if cfg.model != "overlay":
+            raise ValueError("OverlaySimulation requires cfg.model='overlay'")
+        self.cfg = cfg
+        self._run = make_overlay_run(cfg)
+
+    def run(self):
+        import time
+        cfg = self.cfg
+        sched = make_overlay_schedule(cfg)
+        state = init_overlay_state(cfg)
+        t0 = time.perf_counter()
+        final, metrics = self._run(state, sched)
+        jax.block_until_ready(final)
+        if int(np.asarray(final.tick)) != cfg.total_ticks:
+            raise RuntimeError("overlay run did not complete")
+        wall = time.perf_counter() - t0
+        return OverlayResult(cfg=cfg, sched=sched, final_state=final,
+                             metrics=jax.tree.map(np.asarray, metrics),
+                             wall_seconds=wall)

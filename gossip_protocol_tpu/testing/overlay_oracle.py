@@ -1,0 +1,244 @@
+"""Scalar oracle for the overlay model: a plain-numpy, loop-based
+re-implementation of models/overlay.py's tick semantics, used only for
+differential testing at small N.
+
+Because the overlay derives *all* of its randomness and schedules from
+pure counter hashing (utils/hash32.py) — XOR exchange masks, slot
+permutations, rotated tiebreaks, drop decisions, churn membership —
+this oracle replays the exact device behavior with no replay harness,
+and the comparison is bit-exact on the full state trajectory
+(tests/test_overlay.py).  It is deliberately slow and explicit; its
+only job is to be obviously correct.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..config import INTRODUCER, SimConfig
+from ..models.overlay import (BAND, EPOCH, ID_BITS, _SALT_CHURN,
+                              _SALT_CHURN_TICK, _SALT_GOSSIP_DROP,
+                              _SALT_JOINREP_DROP, _SALT_JOINREQ_DROP,
+                              _SALT_MASK, _TIE_BITS, resolved_dims)
+from ..state import NEVER
+from ..utils.hash32 import mix32, threshold32
+
+U = np.uint32
+
+
+class OverlayOracle:
+    def __init__(self, cfg: SimConfig):
+        self.cfg = cfg
+        self.k, self.l, self.f = resolved_dims(cfg)
+        n = cfg.n
+        self.n = n
+        self.seed = U(cfg.seed & 0xFFFFFFFF)
+        self.drop_thr = threshold32(cfg.msg_drop_prob)
+        self.churn_thr = threshold32(cfg.churn_rate) if cfg.churn_rate > 0 else 0
+
+        from fractions import Fraction
+        frac = Fraction(cfg.step_rate).limit_denominator(1 << 15)
+        self.step_num, self.step_den = frac.numerator, max(frac.denominator, 1)
+        self.victim_lo = self.victim_hi = 0
+        if cfg.churn_rate <= 0:
+            from ..utils.prng import fail_schedule_uniform
+            u = fail_schedule_uniform(cfg.seed)
+            if cfg.single_failure:
+                self.victim_lo = int(u * n) % n
+                self.victim_hi = self.victim_lo + 1
+            else:
+                self.victim_lo = (int(u * n) % n) // 2
+                self.victim_hi = self.victim_lo + n // 2
+        self.rejoin_after = (cfg.rejoin_after if cfg.rejoin_after is not None
+                             else NEVER)
+        self.churn_lo = cfg.total_ticks // 4
+        self.churn_span = max(cfg.total_ticks // 2, 1)
+        self.churn_after = (cfg.rejoin_after if cfg.rejoin_after is not None
+                            else 40)
+
+        self.t = 0
+        self.ids = np.full((n, self.k), -1, np.int32)
+        self.hb = np.zeros((n, self.k), np.int32)
+        self.ts = np.zeros((n, self.k), np.int32)
+        self.in_group = np.zeros(n, bool)
+        self.own_hb = np.zeros(n, np.int32)
+        self.send_flags = np.zeros((n, self.f), bool)
+        self.joinreq = np.zeros(n, bool)
+        self.joinrep = np.zeros(n, bool)
+
+    # --- closed-form schedule ---------------------------------------
+    def start_of(self, i):
+        return i * self.step_num // self.step_den
+
+    def fail_of(self, i):
+        if self.churn_thr > 0:
+            if i == INTRODUCER or not (
+                    int(mix32(self.seed, U(i), U(_SALT_CHURN))) < self.churn_thr):
+                return NEVER
+            return self.churn_lo + int(
+                mix32(self.seed, U(i), U(_SALT_CHURN_TICK))) % self.churn_span
+        return (self.cfg.fail_tick
+                if self.victim_lo <= i < self.victim_hi else NEVER)
+
+    def rejoin_of(self, i):
+        fail = self.fail_of(i)
+        after = self.churn_after if self.churn_thr > 0 else self.rejoin_after
+        return fail + after if (fail != NEVER and after != NEVER) else NEVER
+
+    def failed(self, i, t):
+        return self.fail_of(i) < t <= self.rejoin_of(i)
+
+    def drop_active(self, t):
+        return (self.cfg.drop_msg
+                and self.cfg.drop_open_tick < t <= self.cfg.drop_close_tick)
+
+    # --- protocol pieces --------------------------------------------
+    def slot(self, r, j):
+        return int(mix32(self.seed, U(r), U(np.uint32(j))) % self.k)
+
+    def key(self, t, r, j, ts):
+        age = min(max(t - ts, 0), 8 * BAND - 1)
+        band = (7 - age // BAND) << (ID_BITS + _TIE_BITS)
+        tie = (int(mix32(self.seed, U(t // EPOCH), U(r), U(np.uint32(j))))
+               >> (32 - _TIE_BITS)) << ID_BITS
+        return band | tie | (j + 1)
+
+    def mask(self, t, fi):
+        return int(mix32(self.seed, U(np.uint32(t & 0xFFFFFFFF)), U(fi),
+                         U(_SALT_MASK)) % U(self.n - 1)) + 1
+
+    # --- one tick ---------------------------------------------------
+    def step(self):
+        t = self.t
+        n, k, l, f = self.n, self.k, self.l, self.f
+        T = self.cfg.t_remove
+        proc = np.array([t > self.start_of(i) and not self.failed(i, t)
+                         for i in range(n)])
+        rejoining = np.array([self.rejoin_of(i) == t for i in range(n)])
+
+        # churn wipe
+        for i in np.flatnonzero(rejoining):
+            self.ids[i] = -1
+            self.hb[i] = 0
+            self.ts[i] = 0
+            self.in_group[i] = False
+            self.own_hb[i] = 0
+
+        win = [((t - 1) * l + q) % k for q in range(l)]
+
+        # candidates per receiver from the XOR exchange partners
+        cands = [[] for _ in range(n)]
+        recv = 0
+        for fi in range(f):
+            m = self.mask(t - 1, fi)
+            for r in range(n):
+                p = r ^ m
+                if not (self.send_flags[p, fi] and proc[r]):
+                    continue
+                recv += 1
+                for q in win:
+                    if self.ids[p, q] >= 0:
+                        cands[r].append((int(self.ids[p, q]),
+                                         int(self.hb[p, q]),
+                                         int(self.ts[p, q])))
+                cands[r].append((p, int(self.own_hb[p]), t - 1))
+
+        # JOINREP consumption
+        jrep = self.joinrep & proc
+        for r in np.flatnonzero(jrep):
+            for q in win:
+                if self.ids[INTRODUCER, q] >= 0:
+                    cands[r].append((int(self.ids[INTRODUCER, q]),
+                                     int(self.hb[INTRODUCER, q]),
+                                     int(self.ts[INTRODUCER, q])))
+            cands[r].append((INTRODUCER, int(self.own_hb[INTRODUCER]), t - 1))
+            recv += 1
+        in_group = self.in_group | jrep
+
+        # JOINREQ at the introducer
+        jreq = self.joinreq & proc[INTRODUCER]
+        recv += int(jreq.sum())
+        for j in np.flatnonzero(jreq):
+            if j != INTRODUCER:
+                cands[INTRODUCER].append((int(j), 1, t))
+
+        # merge: per-slot max of the packed key; ties merge max ts/hb
+        new_ids = self.ids.copy()
+        new_hb = self.hb.copy()
+        new_ts = self.ts.copy()
+        for r in range(n):
+            best = {}
+            for (j, hb, ts) in cands[r]:
+                if not (t - ts < T) or j == r or j < 0:
+                    continue
+                sl = self.slot(r, j)
+                kkey = self.key(t, r, j, ts)
+                cur = best.get(sl)
+                if cur is None or kkey > cur[0]:
+                    best[sl] = [kkey, ts, hb]
+                elif kkey == cur[0]:
+                    cur[1] = max(cur[1], ts)
+                    cur[2] = max(cur[2], hb)
+            for sl, (kkey, ts, hb) in best.items():
+                if self.ids[r, sl] >= 0:
+                    ckey = self.key(t, r, int(self.ids[r, sl]),
+                                    int(self.ts[r, sl]))
+                    if ckey > kkey:
+                        continue
+                    if ckey == kkey:
+                        new_ts[r, sl] = max(int(self.ts[r, sl]), ts)
+                        new_hb[r, sl] = max(int(self.hb[r, sl]), hb)
+                        continue
+                new_ids[r, sl] = (kkey & ((1 << ID_BITS) - 1)) - 1
+                new_ts[r, sl] = ts
+                new_hb[r, sl] = hb
+
+        # nodeStart / rejoin
+        starting = np.array([self.start_of(i) == t for i in range(n)]) | rejoining
+        in_group = in_group | (starting & (np.arange(n) == INTRODUCER))
+        active = self.drop_active(t)
+        joinreq_sent = np.zeros(n, bool)
+        for i in np.flatnonzero(starting):
+            if i != INTRODUCER:
+                drop = active and int(mix32(self.seed, U(t), U(i),
+                                            U(_SALT_JOINREQ_DROP))) < self.drop_thr
+                joinreq_sent[i] = not drop
+        joinrep_sent = np.zeros(n, bool)
+        for j in np.flatnonzero(jreq):
+            drop = active and int(mix32(self.seed, U(t), U(j),
+                                        U(_SALT_JOINREP_DROP))) < self.drop_thr
+            joinrep_sent[j] = not drop
+
+        # detection
+        ops = proc & in_group
+        self.own_hb = self.own_hb + ops.astype(np.int32)
+        removals = 0
+        for r in np.flatnonzero(ops):
+            for sl in range(k):
+                if new_ids[r, sl] >= 0 and t - new_ts[r, sl] >= T:
+                    removals += 1
+                    new_ids[r, sl] = -1
+                    new_hb[r, sl] = 0
+                    new_ts[r, sl] = 0
+
+        # dissemination: in-flight flags for the next tick
+        new_flags = np.zeros((n, f), bool)
+        sent = int(joinreq_sent.sum()) + int(joinrep_sent.sum())
+        for r in np.flatnonzero(ops):
+            for fi in range(f):
+                gdrop = active and int(mix32(self.seed, U(t), U(r), U(fi),
+                                             U(_SALT_GOSSIP_DROP))) < self.drop_thr
+                if not gdrop:
+                    new_flags[r, fi] = True
+                    sent += 1
+
+        live_hold = ~proc & ~np.array([self.failed(i, t) for i in range(n)])
+        self.joinreq = joinreq_sent | (self.joinreq & (not proc[INTRODUCER])
+                                       & (not self.failed(INTRODUCER, t)))
+        self.joinrep = joinrep_sent | (self.joinrep & live_hold)
+
+        self.ids, self.hb, self.ts = new_ids, new_hb, new_ts
+        self.in_group = in_group
+        self.send_flags = new_flags
+        self.t += 1
+        return dict(sent=sent, recv=recv, removals=removals)

@@ -1,0 +1,147 @@
+"""Overlay model: differential parity against the numpy oracle at
+small N (bit-exact state trajectories — all randomness and schedules
+are shared counter hashing), plus convergence/detection/accuracy
+invariants at medium N.
+
+Accuracy semantics: in a bounded partial view, per-holder staleness
+removals are expected background churn (an entry's refresh is
+arrival-limited); the guarantees asserted here are the global ones —
+every live member stays covered by the group's union of views, failed
+peers are purged everywhere within the detection horizon, and the
+group re-covers rejoining peers (models/overlay.py docstring).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from gossip_protocol_tpu.config import SimConfig
+from gossip_protocol_tpu.models.overlay import (OverlaySimulation,
+                                                init_overlay_state,
+                                                make_overlay_schedule,
+                                                make_overlay_tick)
+from gossip_protocol_tpu.state import NEVER
+from gossip_protocol_tpu.testing.overlay_oracle import OverlayOracle
+
+
+def _overlay_cfg(**kw):
+    base = dict(model="overlay", single_failure=True, drop_msg=False,
+                seed=0, max_nnb=32, total_ticks=80, fail_tick=30)
+    base.update(kw)
+    return SimConfig(**base)
+
+
+@pytest.mark.parametrize("name,kw", [
+    ("plain", {}),
+    ("drop", dict(drop_msg=True, msg_drop_prob=0.15, drop_open_tick=10,
+                  drop_close_tick=60)),
+    ("churn_single", dict(rejoin_after=25, total_ticks=100)),
+    ("churn_rate", dict(single_failure=False, churn_rate=0.3,
+                        rejoin_after=20, total_ticks=120, seed=5)),
+    ("wide", dict(max_nnb=64, seed=3, overlay_view=16, overlay_sample=4,
+                  fanout=4)),
+])
+def test_overlay_oracle_parity(name, kw):
+    """Bit-exact state trajectory vs the scalar oracle."""
+    cfg = _overlay_cfg(**kw)
+    sched = make_overlay_schedule(cfg)
+    tick = jax.jit(make_overlay_tick(cfg))
+    state = init_overlay_state(cfg)
+    oracle = OverlayOracle(cfg)
+    for t in range(cfg.total_ticks):
+        state, m = tick(state, sched)
+        counters = oracle.step()
+        for field in ("ids", "hb", "ts", "send_flags"):
+            got = np.asarray(getattr(state, field))
+            want = getattr(oracle, field)
+            assert np.array_equal(got, want), (name, t, field)
+        assert np.array_equal(np.asarray(state.in_group), oracle.in_group), (name, t)
+        assert np.array_equal(np.asarray(state.own_hb), oracle.own_hb), (name, t)
+        assert np.array_equal(np.asarray(state.joinreq), oracle.joinreq), (name, t)
+        assert np.array_equal(np.asarray(state.joinrep), oracle.joinrep), (name, t)
+        assert int(m.sent) == counters["sent"], (name, t)
+        assert int(m.recv) == counters["recv"], (name, t)
+        assert int(m.removals) == counters["removals"], (name, t)
+
+
+def test_overlay_converges_and_detects():
+    """N=512: everyone joins, the union of views covers every live
+    member every tick after the join phase, and the victim is purged
+    from all views within the detection horizon."""
+    cfg = SimConfig(max_nnb=512, model="overlay", single_failure=True,
+                    drop_msg=False, seed=1, total_ticks=220, fail_tick=120)
+    res = OverlaySimulation(cfg).run()
+    m = res.metrics
+    n = cfg.n
+    joined = np.flatnonzero(np.asarray(m.in_group) == n)
+    last_start = int(cfg.step_rate * (n - 1))
+    assert joined.size and joined[0] <= last_start + 4, "join phase too slow"
+    # global coverage of live members holds once the last joiner's
+    # first gossip lands
+    assert (np.asarray(m.live_uncovered)[joined[0] + 3:] == 0).all()
+    # victim purged from every view within TREMOVE + sampling slack
+    vs = np.asarray(m.victim_slots)
+    horizon = cfg.fail_tick + cfg.t_remove + 10
+    assert (vs[horizon:] == 0).all()
+    assert vs[cfg.fail_tick - 5: cfg.fail_tick].sum() == 0
+    # background per-holder staleness churn stays marginal
+    total_entry_ticks = np.asarray(m.view_slots)[joined[0]:].sum()
+    assert np.asarray(m.false_removals).sum() < 0.001 * total_entry_ticks
+    # views stay near capacity
+    ids = np.asarray(res.final_state.ids)
+    assert (ids >= 0).sum(1).min() >= cfg.overlay_view - 8
+    # host-side final coverage agrees
+    uncovered, victim_left = res.final_coverage()
+    assert uncovered == 0 and victim_left == 0
+
+
+def test_overlay_churn_recovers():
+    """20%-churn shape (the BASELINE 65k scenario, scaled down): churned
+    peers leave, are purged, rejoin, and the group re-covers them."""
+    cfg = SimConfig(max_nnb=512, model="overlay", single_failure=False,
+                    drop_msg=False, seed=2, total_ticks=300,
+                    churn_rate=0.2, rejoin_after=40, step_rate=0.05)
+    sched = make_overlay_schedule(cfg)
+    import jax.numpy as jnp
+    fail = np.asarray(sched.fail_of(jnp.arange(cfg.n)))
+    churned = fail != NEVER
+    assert 0.1 < churned.mean() < 0.3
+    res = OverlaySimulation(cfg).run()
+    m = res.metrics
+    # everyone is back in the group at the end (rejoins completed)
+    assert int(np.asarray(m.in_group)[-1]) == cfg.n
+    # every live member covered at the end, and no victim entries linger
+    assert int(np.asarray(m.live_uncovered)[-1]) == 0
+    assert int(np.asarray(m.victim_slots)[-1]) == 0
+    uncovered, victim_left = res.final_coverage()
+    assert uncovered == 0 and victim_left == 0
+    # churn window saw real failures and removals
+    assert int(np.asarray(m.removals).sum()) > 0
+
+
+def test_overlay_deterministic_and_seed_sensitive():
+    cfg = _overlay_cfg(max_nnb=64, total_ticks=60)
+    r1 = OverlaySimulation(cfg).run()
+    r2 = OverlaySimulation(cfg).run()
+    assert np.array_equal(np.asarray(r1.final_state.ids),
+                          np.asarray(r2.final_state.ids))
+    assert np.array_equal(np.asarray(r1.metrics.sent), np.asarray(r2.metrics.sent))
+    r3 = OverlaySimulation(cfg.replace(seed=9)).run()
+    assert not np.array_equal(np.asarray(r1.final_state.ids),
+                              np.asarray(r3.final_state.ids))
+
+
+def test_overlay_memory_is_bounded():
+    """State is O(N*K), not O(N^2): the tables have the configured
+    widths regardless of N."""
+    cfg = _overlay_cfg(max_nnb=256, overlay_view=32, overlay_sample=8,
+                       fanout=6)
+    s = init_overlay_state(cfg)
+    assert s.ids.shape == (256, 32)
+    assert s.send_flags.shape == (256, 6)
+
+
+def test_overlay_requires_power_of_two():
+    cfg = _overlay_cfg(max_nnb=48)
+    with pytest.raises(AssertionError, match="power of two"):
+        make_overlay_tick(cfg)
