@@ -245,6 +245,10 @@ class OverlayMetrics:
 #: track the live-coverage histogram on device only below this N
 COVERAGE_N_LIMIT = 4096
 
+#: merge pass row-block size (bounds the (B, K, L+1) broadcast
+#: intermediates; see merge_candidates)
+MERGE_BLOCK = 1 << 16
+
 
 def resolved_dims(cfg: SimConfig):
     """(K, L, F): view slots, payload window, exchange fanout.
@@ -260,14 +264,19 @@ def resolved_dims(cfg: SimConfig):
     k = cfg.overlay_view if cfg.overlay_view > 0 \
         else min(64, max(16, 8 * ((b + 1) // 2)))
     l = min(cfg.overlay_sample, k) if cfg.overlay_sample > 0 \
-        else max(4, k // 2)
+        else min(k, max(4, k // 2))
     return k, l, f
 
 
-def _split_hi_lo(n: int):
+def _xor_factors(n: int):
+    """Factor a power-of-two index space for the permutation matmuls.
+
+    A two-way hi/lo split measures fastest on TPU (finer factorizations
+    lower the FLOP count — sum(factors) vs 2*sqrt(N) — but the extra
+    batched contractions cost more in relayouts than they save)."""
     b = n.bit_length() - 1
     hi = 1 << ((b + 1) // 2)
-    return hi, n // hi
+    return [hi, n // hi] if n > 1 else [1]
 
 
 def init_overlay_state(cfg: SimConfig) -> OverlayState:
@@ -296,10 +305,12 @@ def exchange_mask(seed, t, fi, n):
 def _pack_key(seed, t, rows_u, ids, ts):
     """uint32 slot-priority key: freshness band | rotated tie | id+1.
 
-    band (3b): fresher BAND-quantized age wins outright.
-    tie (9b):  mix32(seed, epoch, receiver, id) — re-rolled every EPOCH
-               ticks, per receiver, so slot winners rotate.
-    id+1 (20b): deterministic final tiebreak; nonzero (0 = empty).
+    band (3b, bits 29-31): fresher BAND-quantized age wins outright.
+    tie (_TIE_BITS=8b, bits 21-28): mix32(seed, epoch, receiver, id) —
+               re-rolled every EPOCH ticks, per receiver, so slot
+               winners rotate.
+    id+1 (ID_BITS=21b, bits 0-20): deterministic final tiebreak;
+               nonzero (0 = empty).
     """
     age = jnp.clip(t - ts, 0, 8 * BAND - 1)
     band = (jnp.uint32(7) - (age // BAND).astype(jnp.uint32)) \
@@ -310,8 +321,44 @@ def _pack_key(seed, t, rows_u, ids, ts):
     return band | tie | (ids + 1).astype(jnp.uint32)
 
 
-def make_overlay_tick(cfg: SimConfig):
-    """Build ``tick(state, sched) -> (state', OverlayMetrics)``."""
+class LocalOverlayComm:
+    """Single-device execution: all rows local, collectives trivial."""
+
+    n_shards = 1
+
+    def row_start(self, n: int):
+        return 0
+
+    def slice_rows(self, v):
+        """Replicated [N, ...] -> local row block (identity here)."""
+        return v
+
+    def xor_perm_shards(self, x, mask_hi):
+        """Cross-shard part of the XOR exchange (no-op on one shard)."""
+        return x
+
+    def bcast_row0(self, x_local):
+        """Global row 0 of a row-sharded array, visible everywhere."""
+        return x_local[0]
+
+    def on_first_shard(self):
+        return True
+
+    def psum(self, v):
+        return v
+
+
+def make_overlay_tick(cfg: SimConfig, comm=None):
+    """Build ``tick(state, sched) -> (state', OverlayMetrics)``.
+
+    With the default :class:`LocalOverlayComm` this is a single-device
+    program.  With a :class:`~.overlay_sharded.RingOverlayComm` inside
+    ``shard_map`` the tables/send_flags are row-sharded and the XOR
+    exchange's shard-index bits become a ``ppermute``; all (N,) vectors
+    stay replicated.  Both paths are bit-identical
+    (tests/test_overlay_sharded.py).
+    """
+    comm = comm or LocalOverlayComm()
     n = cfg.n
     k, l, f = resolved_dims(cfg)
     t_remove = cfg.t_remove
@@ -319,73 +366,95 @@ def make_overlay_tick(cfg: SimConfig):
         "(XOR partner exchange)"
     assert n + 1 < (1 << ID_BITS), \
         f"overlay supports N <= {1 << (ID_BITS - 1)}"
-    hi, lo = _split_hi_lo(n)
+    p = comm.n_shards
+    nl = n // p
+    assert nl * p == n and nl & (nl - 1) == 0, \
+        "shard count must divide the peer count (both powers of two)"
+    factors = _xor_factors(nl)
     with_coverage = n <= COVERAGE_N_LIMIT
 
-    rows = jnp.arange(n, dtype=jnp.int32)
-    rows_u = rows.astype(jnp.uint32)
+    rows = jnp.arange(n, dtype=jnp.int32)        # global, replicated
     intro_onehot = rows == INTRODUCER
     kk = jnp.arange(k, dtype=jnp.int32)
-    io_hi = jnp.arange(hi, dtype=jnp.int32)
-    io_lo = jnp.arange(lo, dtype=jnp.int32)
+    iotas = [jnp.arange(s, dtype=jnp.int32) for s in factors]
 
-    def xor_perm(x, mask):
-        """x[i ^ mask] for every row i — two permutation matmuls.
+    _AX = "abcdef"
+
+    def local_xor_perm(x, mask_lo):
+        """x[il ^ mask_lo] over the local rows — one permutation matmul
+        per index factor (_xor_factors), written as transpose-free
+        einsums so each factor is a single MXU contraction.
 
         Exactness matters: payload values go up to N-1 and HIGHEST
         precision keeps the f32 contraction exact (the TPU default
         truncates matmul inputs to bf16, which rounds ids >= 2^16 —
         e.g. 65535 -> 65536 — and corrupts the tables)."""
-        mh, ml = mask // lo, mask % lo
-        ph = (io_hi[:, None] == (io_hi[None, :] ^ mh)).astype(jnp.float32)
-        pl = (io_lo[:, None] == (io_lo[None, :] ^ ml)).astype(jnp.float32)
-        y = x.reshape(hi, lo, x.shape[-1])
-        y = jnp.einsum("ab,bld->ald", ph, y,
-                       precision=jax.lax.Precision.HIGHEST,
-                       preferred_element_type=jnp.float32)
-        y = jnp.einsum("lb,abd->ald", pl, y,
-                       precision=jax.lax.Precision.HIGHEST,
-                       preferred_element_type=jnp.float32)
+        nf = len(factors)
+        y = x.reshape(tuple(factors) + (x.shape[-1],))
+        axes = _AX[:nf] + "D"
+        rem = mask_lo
+        for j in range(nf - 1, -1, -1):
+            s = factors[j]
+            mj = rem % s
+            rem = rem // s
+            pj = (iotas[j][:, None] == (iotas[j][None, :] ^ mj)) \
+                .astype(jnp.float32)
+            out_axes = axes.replace(_AX[j], "x")
+            y = jnp.einsum(f"x{_AX[j]},{axes}->{out_axes}", pj, y,
+                           precision=jax.lax.Precision.HIGHEST,
+                           preferred_element_type=jnp.float32)
         return y.reshape(x.shape)
+
+    def xor_perm(x, mask):
+        """x[i ^ mask] over global rows: local bits via matmuls, shard
+        bits via the comm (a ppermute on a mesh)."""
+        y = local_xor_perm(x, mask % nl)
+        return comm.xor_perm_shards(y, mask // nl)
 
     def tick(state: OverlayState, sched: OverlaySchedule):
         t = state.tick
         tu = t.astype(jnp.uint32)
         seed = sched.seed
+        # replicated (N,) schedule vectors
         start = sched.start_of(rows)
         fail = sched.fail_of(rows)
         rejoin = sched.rejoin_of(rows)
         failed = (t > fail) & (t <= rejoin)
         proc = (t > start) & ~failed
+        rejoining = t == rejoin
+
+        # local row block
+        row_start = comm.row_start(n)
+        rows_g = rows[:nl] + row_start               # global ids of local rows
+        rows_u = rows_g.astype(jnp.uint32)
+        proc_l = comm.slice_rows(proc)
+        keep_l = comm.slice_rows(~rejoining)
 
         # ---- churn wipe (same semantics as core/tick.py) -----------
-        rejoining = t == rejoin
         keep = ~rejoining
-        ids0 = jnp.where(keep[:, None], state.ids, -1)
-        hb0 = state.hb * keep[:, None]
-        ts0 = state.ts * keep[:, None]
+        ids0 = jnp.where(keep_l[:, None], state.ids, -1)
+        hb0 = state.hb * keep_l[:, None]
+        ts0 = state.ts * keep_l[:, None]
         in_group0 = state.in_group & keep
         own_hb0 = state.own_hb * keep
+        own_hb0_l = comm.slice_rows(own_hb0)
 
         # ---- payload of the send tick t-1 --------------------------
         # rotating L-slot window (covers the view every K/L ticks) +
         # the sender's self-entry; all from carried state = frozen at
         # the end of tick t-1
         off = (((t - 1) * l) % k + k) % k
-        idsw = jax.lax.dynamic_slice(
-            jnp.concatenate([ids0, ids0], 1), (0, off), (n, l))
-        hbw = jax.lax.dynamic_slice(
-            jnp.concatenate([hb0, hb0], 1), (0, off), (n, l))
-        tsw = jax.lax.dynamic_slice(
-            jnp.concatenate([ts0, ts0], 1), (0, off), (n, l))
+        idsw = jnp.roll(ids0, -off, axis=1)[:, :l]
+        hbw = jnp.roll(hb0, -off, axis=1)[:, :l]
+        tsw = jnp.roll(ts0, -off, axis=1)[:, :l]
         payload = jnp.concatenate([
             idsw.astype(jnp.float32),
             hbw.astype(jnp.float32),
             tsw.astype(jnp.float32),
-            own_hb0.astype(jnp.float32)[:, None],
-        ], 1)   # (N, 3L+1); the per-slot in-flight flag is appended below
+            own_hb0_l.astype(jnp.float32)[:, None],
+        ], 1)   # (Nl, 3L+1); the per-slot in-flight flag is appended below
 
-        # ---- merge phase: one dense (N, K, L+1) pass per partner ---
+        # ---- merge phase: one dense (Nl, K, L+1) pass per partner --
         cur_key = jnp.where(ids0 >= 0,
                             _pack_key(seed, t, rows_u[:, None], ids0, ts0),
                             0)
@@ -394,12 +463,53 @@ def make_overlay_tick(cfg: SimConfig):
         hb_acc = jnp.where(ids0 >= 0, hb0, 0)
         recv_cnt = jnp.zeros((), jnp.int32)
 
+        def merge_block(rows_u_b, keymax, ts_acc, hb_acc, c_id, c_ts, c_hb,
+                        valid):
+            slot = (mix32(seed, rows_u_b[:, None],
+                          c_id.astype(jnp.uint32)) % k).astype(jnp.int32)
+            key = jnp.where(valid,
+                            _pack_key(seed, t, rows_u_b[:, None], c_id, c_ts),
+                            0)
+            match = slot[:, None, :] == kk[None, :, None]   # (B, K, L+1)
+            kf = (match * key[:, None, :]).max(2)
+            sel = match & (key[:, None, :] == kf[:, :, None]) \
+                & (kf > 0)[:, :, None]
+            ts_f = jnp.where(sel, c_ts[:, None, :], 0).max(2)
+            hb_f = jnp.where(sel, c_hb[:, None, :], 0).max(2)
+            new_max = jnp.maximum(keymax, kf)
+            same = kf == new_max
+            was = keymax == new_max
+            ts_acc = jnp.where(
+                same, jnp.maximum(ts_f, jnp.where(was, ts_acc, 0)), ts_acc)
+            hb_acc = jnp.where(
+                same, jnp.maximum(hb_f, jnp.where(was, hb_acc, 0)), hb_acc)
+            return new_max, ts_acc, hb_acc
+
+        # Row-block the (rows, K, L+1) broadcast intermediates: at 1M
+        # peers a full-width pass is ~9 GB of transient, so process
+        # MERGE_BLOCK rows at a time (lax.map keeps peak memory at one
+        # block while still emitting full-width outputs).
+        n_blocks = max(1, nl // MERGE_BLOCK)
+        blk = nl // n_blocks
+
+        def merge_candidates(carry, c_id, c_ts, c_hb, valid):
+            keymax, ts_acc, hb_acc = carry
+            if n_blocks == 1:
+                return merge_block(rows_u, keymax, ts_acc, hb_acc,
+                                   c_id, c_ts, c_hb, valid)
+            shp = lambda x: x.reshape((n_blocks, blk) + x.shape[1:])
+            out = jax.lax.map(
+                lambda xs: merge_block(*xs),
+                (shp(rows_u), shp(keymax), shp(ts_acc), shp(hb_acc),
+                 shp(c_id), shp(c_ts), shp(c_hb), shp(valid)))
+            return tuple(x.reshape((nl,) + x.shape[2:]) for x in out)
+
         for fi in range(f):
             mask = exchange_mask(seed, t - 1, fi, n)
             flag_col = state.send_flags[:, fi].astype(jnp.float32)[:, None]
             q = xor_perm(
-                jnp.concatenate([payload[:, :3 * l + 1], flag_col], 1), mask)
-            partner = rows ^ mask
+                jnp.concatenate([payload, flag_col], 1), mask)
+            partner = rows_g ^ mask
             c_id = jnp.concatenate(
                 [q[:, :l].astype(jnp.int32), partner[:, None]], 1)
             c_hb = jnp.concatenate(
@@ -407,66 +517,41 @@ def make_overlay_tick(cfg: SimConfig):
                  q[:, 3 * l].astype(jnp.int32)[:, None]], 1)
             c_ts = jnp.concatenate(
                 [q[:, 2 * l:3 * l].astype(jnp.int32),
-                 jnp.broadcast_to(t - 1, (n, 1))], 1)
+                 jnp.broadcast_to(t - 1, (nl, 1))], 1)
             sent_flag = q[:, 3 * l + 1] > 0.5
-            valid = sent_flag[:, None] & proc[:, None] & (c_id >= 0) \
-                & (t - c_ts < t_remove) & (c_id != rows[:, None])
-            recv_cnt += (sent_flag & proc).sum().astype(jnp.int32)
-
-            slot = (mix32(seed, rows_u[:, None],
-                          c_id.astype(jnp.uint32)) % k).astype(jnp.int32)
-            key = jnp.where(valid,
-                            _pack_key(seed, t, rows_u[:, None], c_id, c_ts),
-                            0)
-            match = slot[:, None, :] == kk[None, :, None]    # (N, K, L+1)
-            kf = (match * key[:, None, :]).max(2)
-            sel = match & (key[:, None, :] == kf[:, :, None]) & (kf > 0)[:, :, None]
-            ts_f = jnp.where(sel, c_ts[:, None, :], 0).max(2)
-            hb_f = jnp.where(sel, c_hb[:, None, :], 0).max(2)
-            new_max = jnp.maximum(keymax, kf)
-            same = kf == new_max
-            was = keymax == new_max
-            ts_acc = jnp.where(same, jnp.maximum(ts_f, jnp.where(was, ts_acc, 0)),
-                               ts_acc)
-            hb_acc = jnp.where(same, jnp.maximum(hb_f, jnp.where(was, hb_acc, 0)),
-                               hb_acc)
-            keymax = new_max
+            valid = sent_flag[:, None] & proc_l[:, None] & (c_id >= 0) \
+                & (t - c_ts < t_remove) & (c_id != rows_g[:, None])
+            recv_cnt += (sent_flag & proc_l).sum().astype(jnp.int32)
+            keymax, ts_acc, hb_acc = merge_candidates(
+                (keymax, ts_acc, hb_acc), c_id, c_ts, c_hb, valid)
+        recv_cnt = comm.psum(recv_cnt)
 
         # ---- JOINREP consumption (introducer's payload broadcast) --
         jrep = state.joinrep & proc
-        j_id = jnp.concatenate([idsw[INTRODUCER],
+        jrep_l = comm.slice_rows(jrep)
+        bc = comm.bcast_row0(payload)                # (3L+1,) introducer row
+        j_id = jnp.concatenate([bc[:l].astype(jnp.int32),
                                 jnp.array([INTRODUCER], jnp.int32)])
-        j_hb = jnp.concatenate([hbw[INTRODUCER], own_hb0[INTRODUCER][None]])
-        j_ts = jnp.concatenate([tsw[INTRODUCER], (t - 1)[None]])
-        jc_id = jnp.broadcast_to(j_id, (n, l + 1))
-        jc_ts = jnp.broadcast_to(j_ts, (n, l + 1))
-        jc_hb = jnp.broadcast_to(j_hb, (n, l + 1))
-        j_valid = jrep[:, None] & (jc_id >= 0) & (t - jc_ts < t_remove) \
-            & (jc_id != rows[:, None])
-        slot = (mix32(seed, rows_u[:, None],
-                      jc_id.astype(jnp.uint32)) % k).astype(jnp.int32)
-        key = jnp.where(j_valid,
-                        _pack_key(seed, t, rows_u[:, None], jc_id, jc_ts), 0)
-        match = slot[:, None, :] == kk[None, :, None]
-        kf = (match * key[:, None, :]).max(2)
-        sel = match & (key[:, None, :] == kf[:, :, None]) & (kf > 0)[:, :, None]
-        ts_f = jnp.where(sel, jc_ts[:, None, :], 0).max(2)
-        hb_f = jnp.where(sel, jc_hb[:, None, :], 0).max(2)
-        new_max = jnp.maximum(keymax, kf)
-        same = kf == new_max
-        was = keymax == new_max
-        ts_acc = jnp.where(same, jnp.maximum(ts_f, jnp.where(was, ts_acc, 0)),
-                           ts_acc)
-        hb_acc = jnp.where(same, jnp.maximum(hb_f, jnp.where(was, hb_acc, 0)),
-                           hb_acc)
-        keymax = new_max
+        j_hb = jnp.concatenate([bc[l:2 * l].astype(jnp.int32),
+                                bc[3 * l].astype(jnp.int32)[None]])
+        j_ts = jnp.concatenate([bc[2 * l:3 * l].astype(jnp.int32),
+                                (t - 1)[None]])
+        jc_id = jnp.broadcast_to(j_id, (nl, l + 1))
+        jc_ts = jnp.broadcast_to(j_ts, (nl, l + 1))
+        jc_hb = jnp.broadcast_to(j_hb, (nl, l + 1))
+        j_valid = jrep_l[:, None] & (jc_id >= 0) & (t - jc_ts < t_remove) \
+            & (jc_id != rows_g[:, None])
+        keymax, ts_acc, hb_acc = merge_candidates(
+            (keymax, ts_acc, hb_acc), jc_id, jc_ts, jc_hb, j_valid)
         in_group = in_group0 | jrep
 
         # ---- JOINREQ at the introducer -----------------------------
-        # requester entries (j, hb=1, ts=t) merged into row 0 as a
-        # dense (K, N) masked max (addMember, MP1Node.cpp:265-280)
+        # requester entries (j, hb=1, ts=t) merged into (the shard
+        # holding) row 0 as a dense (K, N) masked max (addMember,
+        # MP1Node.cpp:265-280)
         jreq = state.joinreq & proc[INTRODUCER]
-        q_slot = (mix32(seed, jnp.uint32(INTRODUCER), rows_u) % k) \
+        rows_gu_all = rows.astype(jnp.uint32)
+        q_slot = (mix32(seed, jnp.uint32(INTRODUCER), rows_gu_all) % k) \
             .astype(jnp.int32)
         q_key = jnp.where(jreq & ~intro_onehot,
                           _pack_key(seed, t, jnp.uint32(INTRODUCER), rows,
@@ -476,18 +561,19 @@ def make_overlay_tick(cfg: SimConfig):
         q_sel = q_match & (q_key[None, :] == q_kf[:, None]) & (q_kf > 0)[:, None]
         q_ts = jnp.where(q_sel, t, 0).max(1)
         q_hb = jnp.where(q_sel, 1, 0).max(1)
-        row0_new = jnp.maximum(keymax[INTRODUCER], q_kf)
-        same0 = q_kf == row0_new
-        was0 = keymax[INTRODUCER] == row0_new
+        on0 = comm.on_first_shard()
+        row0_new = jnp.where(on0, jnp.maximum(keymax[0], q_kf), keymax[0])
+        same0 = on0 & (q_kf == row0_new)
+        was0 = keymax[0] == row0_new
         ts0_row = jnp.where(same0,
-                            jnp.maximum(q_ts, jnp.where(was0, ts_acc[INTRODUCER], 0)),
-                            ts_acc[INTRODUCER])
+                            jnp.maximum(q_ts, jnp.where(was0, ts_acc[0], 0)),
+                            ts_acc[0])
         hb0_row = jnp.where(same0,
-                            jnp.maximum(q_hb, jnp.where(was0, hb_acc[INTRODUCER], 0)),
-                            hb_acc[INTRODUCER])
-        keymax = keymax.at[INTRODUCER].set(row0_new)
-        ts_acc = ts_acc.at[INTRODUCER].set(ts0_row)
-        hb_acc = hb_acc.at[INTRODUCER].set(hb0_row)
+                            jnp.maximum(q_hb, jnp.where(was0, hb_acc[0], 0)),
+                            hb_acc[0])
+        keymax = keymax.at[0].set(row0_new)
+        ts_acc = ts_acc.at[0].set(ts0_row)
+        hb_acc = hb_acc.at[0].set(hb0_row)
         recv_cnt += jrep.sum().astype(jnp.int32) + jreq.sum().astype(jnp.int32)
 
         ids1 = jnp.where(keymax > 0,
@@ -495,14 +581,14 @@ def make_overlay_tick(cfg: SimConfig):
         ts1 = jnp.where(keymax > 0, ts_acc, 0)
         hb1 = jnp.where(keymax > 0, hb_acc, 0)
 
-        # ---- nodeStart / rejoin ------------------------------------
+        # ---- nodeStart / rejoin (replicated vector math) -----------
         starting = (t == start) | rejoining
         in_group = in_group | (starting & intro_onehot)
         joinreq_new = starting & ~intro_onehot
         active = sched.drop_active(t)
-        qdrop = mix32(seed, tu, rows_u, np.uint32(_SALT_JOINREQ_DROP)) \
+        qdrop = mix32(seed, tu, rows_gu_all, np.uint32(_SALT_JOINREQ_DROP)) \
             < sched.drop_thr
-        pdrop = mix32(seed, tu, rows_u, np.uint32(_SALT_JOINREP_DROP)) \
+        pdrop = mix32(seed, tu, rows_gu_all, np.uint32(_SALT_JOINREP_DROP)) \
             < sched.drop_thr
         joinreq_sent = joinreq_new & ~(active & qdrop)
         joinrep_sent = jreq & ~(active & pdrop)      # introducer's replies
@@ -510,12 +596,14 @@ def make_overlay_tick(cfg: SimConfig):
         # ---- detection (nodeLoopOps analog) ------------------------
         ops = proc & in_group
         own_hb = own_hb0 + ops.astype(jnp.int32)
-        stale = (ids1 >= 0) & (t - ts1 >= t_remove) & ops[:, None]
+        ops_l = comm.slice_rows(ops)
+        stale = (ids1 >= 0) & (t - ts1 >= t_remove) & ops_l[:, None]
         subj = jnp.clip(ids1, 0)
         subj_fail = sched.fail_of(subj)
         subj_failed = (t > subj_fail) & (t <= sched.rejoin_of(subj))
-        removals = stale.sum().astype(jnp.int32)
-        false_removals = (stale & ~subj_failed).sum().astype(jnp.int32)
+        removals = comm.psum(stale.sum().astype(jnp.int32))
+        false_removals = comm.psum(
+            (stale & ~subj_failed).sum().astype(jnp.int32))
         ids2 = jnp.where(stale, -1, ids1)
         hb2 = jnp.where(stale, 0, hb1)
         ts2 = jnp.where(stale, 0, ts1)
@@ -524,8 +612,8 @@ def make_overlay_tick(cfg: SimConfig):
         fis = jnp.arange(f, dtype=jnp.uint32)
         gdrop = mix32(seed, tu, rows_u[:, None], fis[None, :],
                       np.uint32(_SALT_GOSSIP_DROP)) < sched.drop_thr
-        send_flags = ops[:, None] & ~(active & gdrop)
-        sent = send_flags.sum().astype(jnp.int32) \
+        send_flags = ops_l[:, None] & ~(active & gdrop)
+        sent = comm.psum(send_flags.sum().astype(jnp.int32)) \
             + joinreq_sent.sum().astype(jnp.int32) \
             + joinrep_sent.sum().astype(jnp.int32)
 
@@ -536,19 +624,22 @@ def make_overlay_tick(cfg: SimConfig):
 
         live_member = in_group & ~failed & ~intro_onehot
         if with_coverage:
-            covered = jnp.zeros(n, bool).at[jnp.clip(ids2, 0).reshape(-1)] \
-                .max((ids2 >= 0).reshape(-1))
+            covered = comm.psum(
+                jnp.zeros(n, jnp.int32).at[jnp.clip(ids2, 0).reshape(-1)]
+                .max((ids2 >= 0).reshape(-1).astype(jnp.int32))) > 0
             live_uncovered = (live_member & ~covered).sum().astype(jnp.int32)
         else:
             live_uncovered = jnp.int32(-1)
 
         metrics = OverlayMetrics(
             in_group=in_group.sum().astype(jnp.int32),
-            view_slots=(ids2 >= 0).sum().astype(jnp.int32),
-            adds=((ids1 != ids0) & (ids1 >= 0)).sum().astype(jnp.int32),
+            view_slots=comm.psum((ids2 >= 0).sum().astype(jnp.int32)),
+            adds=comm.psum(
+                ((ids1 != ids0) & (ids1 >= 0)).sum().astype(jnp.int32)),
             removals=removals,
             false_removals=false_removals,
-            victim_slots=((ids2 >= 0) & subj_failed & ~stale).sum().astype(jnp.int32),
+            victim_slots=comm.psum(
+                ((ids2 >= 0) & subj_failed & ~stale).sum().astype(jnp.int32)),
             live_uncovered=live_uncovered,
             sent=sent,
             recv=recv_cnt,

@@ -1,0 +1,146 @@
+"""Sharded overlay: the partial-view model over a device mesh.
+
+Scale-out for the BASELINE 1M-peer config: the peer axis (and with it
+the view tables and send flags) is sharded over a 1-D
+``jax.sharding.Mesh`` axis; all (N,) vectors are replicated.  The XOR
+partner exchange decomposes exactly along the shard split — for
+``N = P * Nl`` (both powers of two) and mask ``m``:
+
+    i ^ m  =  (s ^ m_hi) * Nl  +  (il ^ m_lo)
+
+so the low bits stay the two local permutation matmuls and the high
+bits become a **ppermute** whose pairing XORs the shard index.  The
+mask is a traced per-tick value while ppermute pairings must be
+static, so the comm dispatches through a ``lax.switch`` over the P
+possible shard-XOR permutations (P is small).  Per tick the only
+cross-device traffic is F of these ppermutes plus scalar psums — all
+ICI-resident.
+
+The sharded tick is the *same code* as the single-device tick
+(models/overlay.py, parameterized by comm) and produces bit-identical
+trajectories (tests/test_overlay_sharded.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import SimConfig
+from .overlay import (OverlayMetrics, OverlaySchedule, OverlayState,
+                      make_overlay_tick)
+
+PEER_AXIS = "peers"
+
+
+class RingOverlayComm:
+    """Peer-axis-sharded execution inside ``shard_map``."""
+
+    def __init__(self, axis_name: str, n_shards: int):
+        assert n_shards & (n_shards - 1) == 0, \
+            "shard count must be a power of two (XOR shard exchange)"
+        self.axis = axis_name
+        self.n_shards = n_shards
+
+    def row_start(self, n: int):
+        return lax.axis_index(self.axis).astype(jnp.int32) * (n // self.n_shards)
+
+    def slice_rows(self, v):
+        nl = v.shape[0] // self.n_shards
+        start = lax.axis_index(self.axis) * nl
+        return lax.dynamic_slice_in_dim(v, start, nl, axis=0)
+
+    def xor_perm_shards(self, x, mask_hi):
+        """Route the shard-index bits of the XOR exchange: shard s's
+        block comes from shard ``s ^ mask_hi``.  The pairing must be
+        static for ppermute, so switch over the P possibilities."""
+        p = self.n_shards
+
+        def case(m):
+            if m == 0:
+                return lambda y: y
+            perm = [(s, s ^ m) for s in range(p)]   # (source, destination)
+            return lambda y: lax.ppermute(y, self.axis, perm)
+
+        branches = [case(m) for m in range(p)]
+        return lax.switch(mask_hi, branches, x)
+
+    def bcast_row0(self, x_local):
+        contrib = jnp.where(lax.axis_index(self.axis) == 0,
+                            x_local[0], jnp.zeros_like(x_local[0]))
+        return lax.psum(contrib, self.axis)
+
+    def on_first_shard(self):
+        return lax.axis_index(self.axis) == 0
+
+    def psum(self, v):
+        return lax.psum(v, self.axis)
+
+
+def make_overlay_mesh(n_devices=None, axis: str = PEER_AXIS) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def _state_specs(axis: str) -> OverlayState:
+    mat = P(axis, None)
+    rep = P()
+    return OverlayState(tick=rep, ids=mat, hb=mat, ts=mat,
+                        in_group=rep, own_hb=rep, send_flags=mat,
+                        joinreq=rep, joinrep=rep)
+
+
+def _sched_specs() -> OverlaySchedule:
+    import dataclasses
+    return OverlaySchedule(**{f.name: P() for f in
+                              dataclasses.fields(OverlaySchedule)})
+
+
+def _metric_specs() -> OverlayMetrics:
+    import dataclasses
+    return OverlayMetrics(**{f.name: P() for f in
+                             dataclasses.fields(OverlayMetrics)})
+
+
+_SHARDED_CACHE: dict = {}
+
+
+def make_sharded_overlay_run(cfg: SimConfig, mesh: Mesh,
+                             axis: str = PEER_AXIS):
+    """Build ``run(state, sched) -> (final, metrics[T])`` with the
+    scan-over-ticks inside ``shard_map`` over ``mesh``."""
+    n_shards = mesh.devices.size
+    key = (cfg.n, cfg.t_remove, cfg.total_ticks, cfg.overlay_view,
+           cfg.overlay_sample, cfg.fanout, n_shards, axis, id(mesh))
+    if key in _SHARDED_CACHE:
+        return _SHARDED_CACHE[key]
+
+    comm = RingOverlayComm(axis, n_shards)
+    tick = make_overlay_tick(cfg, comm=comm)
+
+    def body(state: OverlayState, sched: OverlaySchedule):
+        def step(carry, _):
+            return tick(carry, sched)
+        return jax.lax.scan(step, state, None, length=cfg.total_ticks)
+
+    shmapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(_state_specs(axis), _sched_specs()),
+        out_specs=(_state_specs(axis), _metric_specs()),
+    )
+    run = jax.jit(shmapped)
+    _SHARDED_CACHE[key] = run
+    return run
+
+
+def shard_overlay_state(state: OverlayState, mesh: Mesh,
+                        axis: str = PEER_AXIS) -> OverlayState:
+    """Place a host/single-device OverlayState onto the mesh."""
+    specs = _state_specs(axis)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs)

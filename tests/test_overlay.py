@@ -87,9 +87,11 @@ def test_overlay_converges_and_detects():
     # background per-holder staleness churn stays marginal
     total_entry_ticks = np.asarray(m.view_slots)[joined[0]:].sum()
     assert np.asarray(m.false_removals).sum() < 0.001 * total_entry_ticks
-    # views stay near capacity
+    # views stay near capacity (resolved K, not the 0=auto config knob)
+    from gossip_protocol_tpu.models.overlay import resolved_dims
+    k_resolved = resolved_dims(cfg)[0]
     ids = np.asarray(res.final_state.ids)
-    assert (ids >= 0).sum(1).min() >= cfg.overlay_view - 8
+    assert (ids >= 0).sum(1).min() >= k_resolved - 8
     # host-side final coverage agrees
     uncovered, victim_left = res.final_coverage()
     assert uncovered == 0 and victim_left == 0

@@ -1,0 +1,62 @@
+"""Sharded overlay: bit parity with the single-device path.
+
+The tick body is the same code parameterized by comm; over the
+8-virtual-device CPU mesh (tests/conftest.py) a full run must produce
+exactly the single-device trajectory — tables, vectors, and metrics.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from gossip_protocol_tpu.config import SimConfig
+from gossip_protocol_tpu.models.overlay import (init_overlay_state,
+                                                make_overlay_run,
+                                                make_overlay_schedule)
+from gossip_protocol_tpu.models.overlay_sharded import (
+    make_overlay_mesh, make_sharded_overlay_run, shard_overlay_state)
+
+
+def _run_both(cfg, n_devices):
+    sched = make_overlay_schedule(cfg)
+    state = init_overlay_state(cfg)
+
+    run_local = make_overlay_run(cfg)
+    final_l, metrics_l = run_local(state, sched)
+
+    mesh = make_overlay_mesh(n_devices)
+    run_sharded = make_sharded_overlay_run(cfg, mesh)
+    final_s, metrics_s = run_sharded(shard_overlay_state(state, mesh), sched)
+    return (final_l, metrics_l), (final_s, metrics_s)
+
+
+@pytest.mark.parametrize("n_devices", [2, 8])
+@pytest.mark.parametrize("scenario", ["plain", "drop", "churn"])
+def test_sharded_bit_parity(n_devices, scenario):
+    kw = dict(model="overlay", max_nnb=64, seed=3, total_ticks=90,
+              single_failure=True, drop_msg=False, fail_tick=30)
+    if scenario == "drop":
+        kw.update(drop_msg=True, msg_drop_prob=0.15, drop_open_tick=10,
+                  drop_close_tick=70)
+    elif scenario == "churn":
+        kw.update(single_failure=False, churn_rate=0.3, rejoin_after=20,
+                  total_ticks=120)
+    cfg = SimConfig(**kw)
+    (fl, ml), (fs, ms) = _run_both(cfg, n_devices)
+
+    for field in ("ids", "hb", "ts", "send_flags", "in_group", "own_hb",
+                  "joinreq", "joinrep", "tick"):
+        a = np.asarray(getattr(fl, field))
+        b = np.asarray(getattr(fs, field))
+        assert np.array_equal(a, b), field
+    import dataclasses
+    for f in dataclasses.fields(type(ml)):
+        a = np.asarray(getattr(ml, f.name))
+        b = np.asarray(getattr(ms, f.name))
+        assert np.array_equal(a, b), f.name
+
+
+def test_sharded_rejects_non_power_of_two_mesh():
+    from gossip_protocol_tpu.models.overlay_sharded import RingOverlayComm
+    with pytest.raises(AssertionError, match="power of two"):
+        RingOverlayComm("peers", 3)
