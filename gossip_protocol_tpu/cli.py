@@ -43,6 +43,10 @@ def main(argv=None) -> int:
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu, tpu); default: "
                          "jax's own selection")
+    ap.add_argument("--model", default=None, choices=["full_view", "overlay"],
+                    help="protocol family: full_view (reference-faithful, "
+                         "dbg.log output) or overlay (bounded partial-view "
+                         "for large N; prints one summary-metrics JSON line)")
     args = ap.parse_args(argv)
 
     if args.platform:
@@ -59,6 +63,8 @@ def main(argv=None) -> int:
         overrides["max_nnb"] = args.peers
     if args.ticks is not None:
         overrides["total_ticks"] = args.ticks
+    if args.model is not None:
+        overrides["model"] = args.model
     try:
         cfg = SimConfig.from_conf(args.conf, **overrides)
     except (OSError, ValueError) as e:
@@ -66,6 +72,27 @@ def main(argv=None) -> int:
         # (gossip_app.cc), instead of a raw traceback
         print(f"gossip_protocol_tpu: {e}", file=sys.stderr)
         return 2
+
+    if cfg.model == "overlay":
+        # the overlay reports scalar metrics, not per-event logs
+        # (events at 65k+ cannot be dense masks; models/overlay.py)
+        import numpy as np
+
+        from .models.overlay import OverlaySimulation
+        res = OverlaySimulation(cfg).run()
+        m = res.metrics
+        uncovered, victims_left = res.final_coverage()
+        print(json.dumps({
+            "n": cfg.n, "ticks": cfg.total_ticks,
+            "wall_s": round(res.wall_seconds, 6),
+            "node_ticks_per_s": round(res.node_ticks_per_second, 1),
+            "in_group_final": int(np.asarray(m.in_group)[-1]),
+            "victim_slots_final": int(np.asarray(m.victim_slots)[-1]),
+            "live_uncovered_final": uncovered,
+            "victim_entries_final": victims_left,
+            "removals_total": int(np.asarray(m.removals).sum()),
+        }))
+        return 0
 
     from .core.sim import Simulation
 

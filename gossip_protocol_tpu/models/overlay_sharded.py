@@ -83,6 +83,10 @@ class RingOverlayComm:
 def make_overlay_mesh(n_devices=None, axis: str = PEER_AXIS) -> Mesh:
     devs = jax.devices()
     if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"requested a {n_devices}-device mesh but only "
+                f"{len(devs)} devices are available")
         devs = devs[:n_devices]
     return Mesh(np.array(devs), (axis,))
 
@@ -116,7 +120,7 @@ def make_sharded_overlay_run(cfg: SimConfig, mesh: Mesh,
     scan-over-ticks inside ``shard_map`` over ``mesh``."""
     n_shards = mesh.devices.size
     key = (cfg.n, cfg.t_remove, cfg.total_ticks, cfg.overlay_view,
-           cfg.overlay_sample, cfg.fanout, n_shards, axis, id(mesh))
+           cfg.overlay_sample, cfg.fanout, axis, mesh)
     if key in _SHARDED_CACHE:
         return _SHARDED_CACHE[key]
 

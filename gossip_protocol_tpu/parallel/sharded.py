@@ -67,7 +67,7 @@ def make_sharded_run(cfg: SimConfig, mesh: Mesh, block_size: int = 128,
     n_shards = mesh.devices.size
     comm = RingComm(axis, n_shards, use_pallas)
     key = (cfg.n, cfg.t_remove, cfg.total_ticks, block_size, with_events,
-           n_shards, axis, id(mesh), comm.use_pallas,
+           axis, mesh, comm.use_pallas,
            cfg.rejoin_after is not None)
     if key in _SHARDED_CACHE:
         return _SHARDED_CACHE[key]
