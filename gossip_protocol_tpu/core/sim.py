@@ -106,7 +106,8 @@ class Simulation:
 
     def run(self, seed: Optional[int] = None,
             resume_from: Optional[WorldState] = None,
-            ticks: Optional[int] = None) -> SimResult:
+            ticks: Optional[int] = None,
+            profile_dir: Optional[str] = None) -> SimResult:
         """Trace-mode run: full event masks for logging/grading.
 
         ``resume_from`` continues a previous (possibly checkpointed)
@@ -116,7 +117,15 @@ class Simulation:
         always runs 0..700, Application.cpp:99).  ``ticks`` stops the
         segment early (e.g. to checkpoint mid-run); the default runs
         through ``cfg.total_ticks``.
+
+        ``profile_dir`` wraps the run in ``jax.profiler.trace`` and
+        writes a TensorBoard-loadable profile there — the framework's
+        answer to the reference's (absent) tracer, SURVEY.md §5.
         """
+        if profile_dir is not None:
+            with jax.profiler.trace(profile_dir):
+                return self.run(seed=seed, resume_from=resume_from,
+                                ticks=ticks)
         if seed is not None and resume_from is not None:
             raise ValueError(
                 "seed and resume_from are mutually exclusive: a reseeded "

@@ -73,21 +73,33 @@ class TickEvents:
 
 
 def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
-              use_pallas: bool | None = None):
+              use_pallas: bool | None = None, with_events: bool = True):
     """Build the tick function for a config (shapes are static).
 
     Returned signature: ``tick(state, sched) -> (state', TickEvents)``.
     With a :class:`RingComm`, call it inside ``shard_map`` with (N, N)
     arrays sharded ``P(axis, None)`` and everything else replicated.
-    ``use_pallas`` routes the merge reduction through the fused Pallas
-    kernel (None = auto: on for TPU backends); ignored when an explicit
-    ``comm`` is passed (the comm carries its own merge implementation).
+    ``use_pallas`` routes the matrix phases through Pallas (None =
+    auto: on for TPU backends); on the single-device path this uses
+    the fully-fused tick kernel (ops/pallas/tickfused.py) — merge,
+    membership update, detection, and dissemination in one launch —
+    while the sharded ring path uses the composable merge kernel.
+    ``use_pallas`` is ignored when an explicit ``comm`` is passed.
     """
     comm = comm or LocalComm(use_pallas)
     n = cfg.n
     t_remove = cfg.t_remove
     churn = cfg.rejoin_after is not None
     assert n % comm.n_shards == 0, "peer count must divide the mesh axis"
+    # the fused kernel needs its exact tile divisibility (row tile 64,
+    # sender tile = block_size, both sublane-aligned — mirrors the
+    # asserts in fused_tick_update); everything else falls back to the
+    # composable ops
+    _tr = min(64, n)
+    _tss = min(block_size, n)
+    fused = (isinstance(comm, LocalComm) and comm.use_pallas
+             and n % _tr == 0 and n % _tss == 0
+             and _tr % 8 == 0 and _tss % 8 == 0)
 
     def tick(state: WorldState, sched: Schedule):
         t = state.tick
@@ -130,6 +142,65 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
         jreq = state.joinreq & proc[INTRODUCER]          # requests the introducer processes
         jrep = state.joinrep & proc                      # JOINREPs joiners process
         recv_from = comm.transpose(deliver)              # [rows=r, s]
+
+        # ---- nodeStart + per-tick vector decisions -----------------
+        # (hoisted before the matrix phases — pure dataflow, and the
+        # fused kernel consumes them).  The driver's introduction
+        # branch does NOT check bFailed (only recvLoop and nodeLoop do,
+        # Application.cpp:130,153), so a peer whose start tick falls
+        # after its fail tick still sends its JOINREQ: the introducer
+        # admits it, gossips its (forever-silent) entry, and everyone
+        # removes it TREMOVE ticks later.  A churned peer's rejoin is
+        # the same path (a fresh nodeStart).
+        starting = (t == sched.start_tick) | rejoining
+        joinreq_new = starting & ~intro_onehot           # JOINREQ send
+        in_group = st_in_group | jrep
+        in_group = in_group | (starting & intro_onehot)  # "Starting up group..."
+        # nodeLoopOps gate: started, live, in-group (MP1Node.cpp:185-190;
+        # in_group may have been set this very tick, MP1Node.cpp:182-190)
+        ops = proc & in_group
+        own_hb = st_own_hb + ops.astype(jnp.int32)       # MP1Node.cpp:337
+        ops_rows = ops[row_ids]
+
+        # ENsend drop injection (EmulNet.cpp:90-94)
+        gdrop_all, qdrop, pdrop = tick_drop_masks(
+            state.rng, t, n, sched.drop_active[t], sched.drop_prob)
+        gdrop = comm.slice_rows(gdrop_all)               # local sender rows
+        joinreq_sent = joinreq_new & ~qdrop
+        rep_out = jreq
+        joinrep_sent = rep_out & ~pdrop
+        live_hold = ~proc & ~failed
+
+        if fused:
+            # one Pallas pass: merge + membership update + detection +
+            # dissemination (ops/pallas/tickfused.py)
+            from ..ops.pallas.tickfused import fused_tick_update
+            known, hb, ts, gossip_next, gsent_row, added_m, removed_m = \
+                fused_tick_update(
+                    recv_from, st_known, st_hb, st_ts, state.gossip, gdrop,
+                    ops, jrep, jreq, live_hold, t, t_remove=t_remove,
+                    tile_s=block_size, with_events=with_events)
+            joinreq_next = joinreq_sent | (state.joinreq
+                                           & ~proc[INTRODUCER]
+                                           & ~failed[INTRODUCER])
+            joinrep_next = joinrep_sent | (state.joinrep & live_hold)
+            rep_total = joinrep_sent.sum().astype(jnp.int32)
+            req_total = jreq.sum().astype(jnp.int32)
+            sent = gsent_row + joinreq_sent.astype(jnp.int32) \
+                + jnp.where(is_intro_row, rep_total, 0)
+            recv = recv_from.sum(1).astype(jnp.int32) \
+                + jrep.astype(jnp.int32) \
+                + jnp.where(is_intro_row, req_total, 0)
+            zero_ev = jnp.zeros((), bool)
+            events = TickEvents(
+                added=added_m if with_events else zero_ev,
+                removed=removed_m if with_events else zero_ev,
+                sent=sent, recv=recv)
+            new_state = WorldState(
+                tick=t + 1, in_group=in_group, own_hb=own_hb,
+                known=known, hb=hb, ts=ts, gossip=gossip_next,
+                joinreq=joinreq_next, joinrep=joinrep_next, rng=state.rng)
+            return new_state, events
 
         # ---- checkMessages: GOSSIP piggyback merge -----------------
         # (MP1Node.cpp:244-256; add path MP1Node.cpp:282-301)
@@ -174,7 +245,6 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
         known = known | q_cell
         hb = jnp.where(q_cell, 1, hb)
         ts = jnp.where(q_cell, t, ts)
-        rep_out = jreq
 
         # ---- checkMessages: JOINREP at the joiner ------------------
         # add the introducer (dedup'd — usually already added via its
@@ -185,51 +255,22 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
         known = known | r_cell
         hb = jnp.where(r_cell, 1, hb)
         ts = jnp.where(r_cell, t, ts)
-        in_group = st_in_group | jrep
 
         known_after_adds = known
 
-        # ---- nodeStart: staggered introduction ---------------------
-        # (Application.cpp:143-148; MP1Node.cpp:120-154).  The driver's
-        # introduction branch does NOT check bFailed (only recvLoop and
-        # nodeLoop do, Application.cpp:130,153), so a peer whose start
-        # tick falls after its fail tick still sends its JOINREQ: the
-        # introducer admits it, gossips its (forever-silent) entry, and
-        # everyone removes it TREMOVE ticks later.  Reachable whenever
-        # start_tick > fail_tick, i.e. N > 404 with the stock schedule.
-        # A churned peer's rejoin is the same path (a fresh nodeStart).
-        starting = (t == sched.start_tick) | rejoining
-        in_group = in_group | (starting & intro_onehot)  # "Starting up group..."
-        joinreq_new = starting & ~intro_onehot           # JOINREQ send
-
-        # ---- nodeLoopOps: heartbeat, detection, dissemination ------
-        # only started, live, in-group nodes (MP1Node.cpp:185-190);
-        # in_group may have been set this very tick (JOINREP processed
-        # in checkMessages before the in-group test, MP1Node.cpp:182-190)
-        ops = proc & in_group
-        own_hb = st_own_hb + ops.astype(jnp.int32)       # MP1Node.cpp:337
-        ops_rows = ops[row_ids]
-
+        # ---- nodeLoopOps: detection, dissemination -----------------
         stale = staleness_mask(ops_rows, known, ts, t, t_remove)
         known = known & ~stale
 
         # full-list gossip to every remaining member (MP1Node.cpp:350-361)
         send = ops_rows[:, None] & known
-
-        # ---- ENsend drop injection (EmulNet.cpp:90-94) -------------
-        gdrop_all, qdrop, pdrop = tick_drop_masks(
-            state.rng, t, n, sched.drop_active[t], sched.drop_prob)
-        gdrop = comm.slice_rows(gdrop_all)               # local sender rows
         gossip_sent = send & ~gdrop
-        joinreq_sent = joinreq_new & ~qdrop
-        joinrep_sent = rep_out & ~pdrop
 
         # unconsumed traffic stays in flight (the EmulNet buffer holds
         # messages until the receiver's next recvLoop) — except traffic
         # to failed receivers, which in the reference rots in the buffer
         # forever (failed nodes never call recvLoop again,
         # Application.cpp:130, MP1Node.cpp:42-44) and is dropped here.
-        live_hold = ~proc & ~failed
         gossip_next = gossip_sent | (state.gossip & live_hold[None, :])
         joinreq_next = joinreq_sent | (state.joinreq
                                        & ~proc[INTRODUCER] & ~failed[INTRODUCER])
@@ -288,7 +329,7 @@ def make_run(cfg: SimConfig, block_size: int = 128, with_events: bool = True,
            comm.use_pallas, cfg.rejoin_after is not None)
     if key in _RUN_CACHE:
         return _RUN_CACHE[key]
-    tick = make_tick(cfg, block_size, comm=comm)
+    tick = make_tick(cfg, block_size, comm=comm, with_events=with_events)
 
     @jax.jit
     def run(state: WorldState, sched: Schedule):

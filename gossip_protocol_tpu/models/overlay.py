@@ -720,8 +720,13 @@ class OverlaySimulation:
         self.cfg = cfg
         self._run = make_overlay_run(cfg)
 
-    def run(self):
+    def run(self, profile_dir=None):
+        """Run the configured scenario; ``profile_dir`` wraps the run
+        in ``jax.profiler.trace`` (SURVEY.md §5 tracing hook)."""
         import time
+        if profile_dir is not None:
+            with jax.profiler.trace(profile_dir):
+                return self.run()
         cfg = self.cfg
         sched = make_overlay_schedule(cfg)
         state = init_overlay_state(cfg)

@@ -83,3 +83,15 @@ def test_checkpoint_path_used_verbatim(tmp_path):
     want = state_to_host(res.final_state)
     for k in want:
         assert np.array_equal(want[k], back[k]), k
+
+
+def test_profile_hook_writes_trace(tmp_path):
+    """run(profile_dir=...) wraps the run in jax.profiler.trace and
+    produces a TensorBoard-loadable profile (SURVEY.md §5)."""
+    import os
+
+    cfg = scenario_cfg("singlefailure", seed=0)
+    res = Simulation(cfg).run(ticks=10, profile_dir=str(tmp_path))
+    assert int(np.asarray(res.final_state.tick)) == 10
+    found = [f for _, _, fs in os.walk(tmp_path) for f in fs]
+    assert any(f.endswith(".xplane.pb") for f in found), found

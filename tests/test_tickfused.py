@@ -1,0 +1,90 @@
+"""Differential tests for the fused tick kernel (ops/pallas/tickfused.py):
+the single-launch merge+update+detect+send pass must be bit-identical
+to the composable-op tick — states, events, and accounting — across
+scenario shapes (interpret mode on CPU; the same comparison passes on
+real TPU hardware against the Mosaic-compiled kernel)."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from gossip_protocol_tpu.config import SimConfig
+from gossip_protocol_tpu.core.tick import make_tick
+from gossip_protocol_tpu.parallel.comm import LocalComm
+from gossip_protocol_tpu.state import init_state, make_schedule
+from tests.conftest import scenario_cfg
+
+
+@pytest.mark.parametrize("name,kw", [
+    ("drop", dict(max_nnb=24, seed=7, total_ticks=160)),
+    ("churn", dict(max_nnb=16, seed=2, fail_tick=30, rejoin_after=25,
+                   total_ticks=120)),
+    ("start_after_fail", dict(max_nnb=24, seed=0, fail_tick=3,
+                              single_failure=False, total_ticks=80)),
+])
+def test_fused_tick_bit_parity(name, kw):
+    scen = "msgdropsinglefailure" if name == "drop" else "singlefailure"
+    cfg = scenario_cfg(scen, **kw)
+    tick_ref = jax.jit(make_tick(cfg, comm=LocalComm(use_pallas=False)))
+    tick_fus = jax.jit(make_tick(cfg, use_pallas=True))
+    sched = make_schedule(cfg)
+    s1 = s2 = init_state(cfg)
+    for t in range(cfg.total_ticks):
+        s1, e1 = tick_ref(s1, sched)
+        s2, e2 = tick_fus(s2, sched)
+        for f in dataclasses.fields(type(s1)):
+            a = np.asarray(getattr(s1, f.name))
+            b = np.asarray(getattr(s2, f.name))
+            assert np.array_equal(a, b), (name, t, f.name)
+        for f in dataclasses.fields(type(e1)):
+            a = np.asarray(getattr(e1, f.name))
+            b = np.asarray(getattr(e2, f.name))
+            assert np.array_equal(a, b), (name, t, "ev." + f.name)
+
+
+def test_fused_gate_falls_back_on_odd_n():
+    """N not divisible by the kernel tiling uses the composable ops
+    (still under use_pallas: the merge kernel pads internally)."""
+    cfg = scenario_cfg("singlefailure", max_nnb=10, total_ticks=30, seed=1)
+    tick_ref = jax.jit(make_tick(cfg, comm=LocalComm(use_pallas=False)))
+    tick_pal = jax.jit(make_tick(cfg, use_pallas=True))
+    sched = make_schedule(cfg)
+    s1 = s2 = init_state(cfg)
+    for _ in range(cfg.total_ticks):
+        s1, _ = tick_ref(s1, sched)
+        s2, _ = tick_pal(s2, sched)
+    for f in dataclasses.fields(type(s1)):
+        assert np.array_equal(np.asarray(getattr(s1, f.name)),
+                              np.asarray(getattr(s2, f.name))), f.name
+
+
+@pytest.mark.slow
+def test_fused_multi_tile_grid_parity():
+    """Exercise the kernel's real tiling machinery: at N=256 the grid
+    has 4 row tiles and 2 sender steps, so the cross-k scratch
+    accumulation and the k==0 / k==num_k-1 gating are live (at tiny N
+    they degenerate to a single program).  Covers both event modes."""
+    cfg = SimConfig(max_nnb=256, single_failure=False, drop_msg=True,
+                    msg_drop_prob=0.1, seed=5, total_ticks=40,
+                    fail_tick=15)
+    tick_ref = jax.jit(make_tick(cfg, comm=LocalComm(use_pallas=False)))
+    tick_fus = jax.jit(make_tick(cfg, use_pallas=True))
+    tick_fus_bench = jax.jit(make_tick(cfg, use_pallas=True,
+                                       with_events=False))
+    sched = make_schedule(cfg)
+    s1 = s2 = s3 = init_state(cfg)
+    for t in range(cfg.total_ticks):
+        s1, e1 = tick_ref(s1, sched)
+        s2, e2 = tick_fus(s2, sched)
+        s3, e3 = tick_fus_bench(s3, sched)
+        for f in dataclasses.fields(type(s1)):
+            a = np.asarray(getattr(s1, f.name))
+            assert np.array_equal(a, np.asarray(getattr(s2, f.name))), (t, f.name)
+            assert np.array_equal(a, np.asarray(getattr(s3, f.name))), (t, f.name)
+        for f in dataclasses.fields(type(e1)):
+            assert np.array_equal(np.asarray(getattr(e1, f.name)),
+                                  np.asarray(getattr(e2, f.name))), (t, f.name)
+        assert np.array_equal(np.asarray(e1.sent), np.asarray(e3.sent))
+        assert np.array_equal(np.asarray(e1.recv), np.asarray(e3.recv))
