@@ -58,15 +58,26 @@ def _backend_or_cpu(timeout_s: float = 180.0) -> str:
     return backend if backend not in ("error",) else "cpu"
 
 
-def bench_overlay(n: int, ticks: int):
+def bench_overlay(n: int, ticks: int, drop: bool = False):
+    """BASELINE configs: 20% churn (the 65k shape) or 10% message drop
+    (the 4096 shape)."""
     import numpy as np
 
     from gossip_protocol_tpu.config import SimConfig
     from gossip_protocol_tpu.models.overlay import OverlaySimulation
 
-    cfg = SimConfig(max_nnb=n, model="overlay", single_failure=False,
-                    drop_msg=False, seed=0, total_ticks=ticks,
-                    churn_rate=0.2, rejoin_after=40, step_rate=64.0 / n)
+    if drop:
+        # like the reference's msgdrop scenario, the join ramp finishes
+        # before the drop window opens (tick 50), so a dropped JOINREQ
+        # can never orphan a peer
+        cfg = SimConfig(max_nnb=n, model="overlay", single_failure=True,
+                        drop_msg=True, msg_drop_prob=0.1, seed=0,
+                        total_ticks=ticks, fail_tick=ticks // 2,
+                        step_rate=40.0 / n)
+    else:
+        cfg = SimConfig(max_nnb=n, model="overlay", single_failure=False,
+                        drop_msg=False, seed=0, total_ticks=ticks,
+                        churn_rate=0.2, rejoin_after=40, step_rate=64.0 / n)
     sim = OverlaySimulation(cfg)
     sim.run()                     # compile + warm
     best = None
@@ -75,7 +86,10 @@ def bench_overlay(n: int, ticks: int):
         if best is None or res.wall_seconds < best.wall_seconds:
             best = res
     # validate before reporting: the number only counts if the run is
-    # a correct simulation (not assert: must survive -O)
+    # a correct simulation (not assert: must survive -O).  in_group
+    # must be exactly n in both modes: churned peers rejoin, and a
+    # scripted-failure victim keeps its flag (only the churn wipe
+    # clears it) — anything less means an orphaned joiner.
     m = best.metrics
     if int(np.asarray(m.in_group)[-1]) != n:
         raise RuntimeError("overlay bench: join/rejoin incomplete")
@@ -121,6 +135,8 @@ def main():
         n_overlay, t_overlay, n_dense, t_dense = 65536, 300, 512, 700
 
     overlay = bench_overlay(n_overlay, t_overlay)
+    n_drop = min(4096, n_overlay)              # BASELINE "4096, 10% drop"
+    overlay_drop = bench_overlay(n_drop, max(t_overlay, 200), drop=True)
     dense = bench_dense(n_dense, t_dense)
 
     print(json.dumps({
@@ -130,6 +146,9 @@ def main():
         "vs_baseline": round(overlay / REFERENCE_NODE_TICKS_PER_S, 3),
         "backend": backend,
         "secondary": {
+            f"node_ticks_per_s_n{n_drop}_overlay_drop10": round(overlay_drop, 1),
+            "overlay_drop10_vs_baseline": round(
+                overlay_drop / REFERENCE_NODE_TICKS_PER_S, 3),
             f"node_ticks_per_s_n{n_dense}_fullview": round(dense, 1),
             "fullview_vs_baseline": round(dense / REFERENCE_NODE_TICKS_PER_S, 3),
         },

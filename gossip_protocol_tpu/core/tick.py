@@ -93,12 +93,14 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
     assert n % comm.n_shards == 0, "peer count must divide the mesh axis"
     # the fused kernel needs its exact tile divisibility (row tile 64,
     # sender tile = block_size, both sublane-aligned — mirrors the
-    # asserts in fused_tick_update); everything else falls back to the
-    # composable ops
+    # asserts in fused_tick_update) and bounded VMEM: its column tiles
+    # span the full peer axis, and n=1024 already exceeds the 16 MB
+    # scoped-VMEM budget (measured).  Everything else falls back to
+    # the composable ops.
     _tr = min(64, n)
     _tss = min(block_size, n)
     fused = (isinstance(comm, LocalComm) and comm.use_pallas
-             and n % _tr == 0 and n % _tss == 0
+             and n <= 512 and n % _tr == 0 and n % _tss == 0
              and _tr % 8 == 0 and _tss % 8 == 0)
 
     def tick(state: WorldState, sched: Schedule):

@@ -191,7 +191,8 @@ def fused_tick_update(recv_from, known, hb, ts, gossip, gdrop,
     n = known.shape[0]
     tr = min(tile_r, n)
     tss = min(tile_s, n)
-    assert n % tr == 0 and n % tss == 0 and tss % _SUB == 0, (n, tr, tss)
+    assert n % tr == 0 and n % tss == 0 and tss % _SUB == 0 \
+        and tr % _SUB == 0, (n, tr, tss)
 
     i32 = jnp.int32
     rowvec = jnp.stack([ops.astype(i32), jrep.astype(i32),
