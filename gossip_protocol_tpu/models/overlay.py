@@ -663,9 +663,13 @@ def make_overlay_tick(cfg: SimConfig, comm=None):
 _OVERLAY_RUN_CACHE: dict = {}
 
 
-def make_overlay_run(cfg: SimConfig):
-    """Whole-run ``lax.scan``: ``run(state, sched) -> (final, metrics[T])``."""
-    key = (cfg.n, cfg.t_remove, cfg.total_ticks, resolved_dims(cfg))
+def make_overlay_run(cfg: SimConfig, length: int | None = None):
+    """``lax.scan`` over ``length`` ticks (default: the whole run):
+    ``run(state, sched) -> (final, metrics[length])``.  The schedule is
+    closed-form in the absolute clock carried in the state, so a
+    shorter scan resumes mid-run bit-identically."""
+    length = cfg.total_ticks if length is None else length
+    key = (cfg.n, cfg.t_remove, length, resolved_dims(cfg))
     if key in _OVERLAY_RUN_CACHE:
         return _OVERLAY_RUN_CACHE[key]
     tick = make_overlay_tick(cfg)
@@ -674,10 +678,41 @@ def make_overlay_run(cfg: SimConfig):
     def run(state: OverlayState, sched: OverlaySchedule):
         def step(carry, _):
             return tick(carry, sched)
-        return jax.lax.scan(step, state, None, length=cfg.total_ticks)
+        return jax.lax.scan(step, state, None, length=length)
 
     _OVERLAY_RUN_CACHE[key] = run
     return run
+
+
+def _overlay_expect(host):
+    n, k = np.asarray(host["ids"]).shape
+    f = np.asarray(host["send_flags"]).shape[1]
+    return {"tick": (), "ids": (n, k), "hb": (n, k), "ts": (n, k),
+            "in_group": (n,), "own_hb": (n,), "send_flags": (n, f),
+            "joinreq": (n,), "joinrep": (n,)}
+
+
+def overlay_state_to_host(state: OverlayState) -> dict:
+    """Device state -> plain numpy dict (checkpointing)."""
+    from ..state import struct_to_host
+    return struct_to_host(state)
+
+
+def overlay_state_from_host(host: dict) -> OverlayState:
+    """Inverse of :func:`overlay_state_to_host`, schema-checked."""
+    from ..state import struct_from_host
+    return struct_from_host(host, OverlayState, _overlay_expect)
+
+
+def save_overlay_checkpoint(state: OverlayState, path: str) -> None:
+    """Write a mid-run checkpoint; the path is used verbatim."""
+    from ..state import save_struct_checkpoint
+    save_struct_checkpoint(state, path)
+
+
+def load_overlay_checkpoint(path: str) -> OverlayState:
+    from ..state import load_struct_checkpoint
+    return load_struct_checkpoint(path, OverlayState, _overlay_expect)
 
 
 @dataclasses.dataclass
@@ -689,16 +724,23 @@ class OverlayResult:
     wall_seconds: float
 
     @property
+    def ticks_run(self) -> int:
+        """Ticks executed in this (possibly partial) segment."""
+        return int(np.asarray(self.metrics.in_group).shape[0])
+
+    @property
     def node_ticks_per_second(self) -> float:
-        return self.cfg.n * self.cfg.total_ticks / self.wall_seconds
+        return self.cfg.n * self.ticks_run / self.wall_seconds
 
     def final_coverage(self):
         """(live_uncovered_count, victim_entries_left) from the final
         tables, computed on host — the large-N stand-in for the
-        per-tick coverage histogram."""
+        per-tick coverage histogram.  Evaluated at the state's own
+        clock, so partial segments are judged against the schedule at
+        their stopping point."""
         ids = np.asarray(self.final_state.ids)
         n = self.cfg.n
-        t_end = self.cfg.total_ticks
+        t_end = int(np.asarray(self.final_state.tick))
         if ids.max() >= n:
             raise AssertionError(
                 f"corrupt view table: id {ids.max()} >= N={n}")
@@ -724,20 +766,37 @@ class OverlaySimulation:
         self.cfg = cfg
         self._run = make_overlay_run(cfg)
 
-    def run(self, profile_dir=None):
-        """Run the configured scenario; ``profile_dir`` wraps the run
-        in ``jax.profiler.trace`` (SURVEY.md §5 tracing hook)."""
+    def run(self, profile_dir=None, resume_from: OverlayState | None = None,
+            ticks: int | None = None):
+        """Run the configured scenario.
+
+        ``resume_from`` continues a (possibly checkpointed) state —
+        the clock and in-flight flags live in the state and the
+        schedule is closed-form in the absolute clock, so the
+        continuation is bit-identical to an uninterrupted run.
+        ``ticks`` stops the segment early (to checkpoint mid-run).
+        ``profile_dir`` wraps the run in ``jax.profiler.trace``
+        (SURVEY.md §5 tracing hook).
+        """
         import time
         if profile_dir is not None:
             with jax.profiler.trace(profile_dir):
-                return self.run()
+                return self.run(resume_from=resume_from, ticks=ticks)
         cfg = self.cfg
         sched = make_overlay_schedule(cfg)
-        state = init_overlay_state(cfg)
+        state = init_overlay_state(cfg) if resume_from is None else resume_from
+        first = int(np.asarray(state.tick))
+        if first > cfg.total_ticks:
+            raise ValueError(
+                f"resume_from is at tick {first}, past total_ticks="
+                f"{cfg.total_ticks}")
+        t_end = cfg.total_ticks if ticks is None \
+            else min(cfg.total_ticks, first + ticks)
+        run = make_overlay_run(cfg, t_end - first)
         t0 = time.perf_counter()
-        final, metrics = self._run(state, sched)
+        final, metrics = run(state, sched)
         jax.block_until_ready(final)
-        if int(np.asarray(final.tick)) != cfg.total_ticks:
+        if int(np.asarray(final.tick)) != t_end:
             raise RuntimeError("overlay run did not complete")
         wall = time.perf_counter() - t0
         return OverlayResult(cfg=cfg, sched=sched, final_state=final,

@@ -178,10 +178,61 @@ def init_state(cfg: SimConfig) -> WorldState:
     )
 
 
+def struct_to_host(state) -> dict[str, np.ndarray]:
+    """Any state struct -> plain numpy dict (checkpointing/debugging)."""
+    return {f.name: np.asarray(getattr(state, f.name))
+            for f in dataclasses.fields(type(state))}
+
+
+def struct_from_host(host: dict[str, np.ndarray], cls, expect_shapes):
+    """Rebuild a state struct from a host dict, schema-checked.
+
+    ``expect_shapes(host) -> {field: shape}`` derives the expected
+    geometry from the checkpoint itself (e.g. its peer count).
+    """
+    names = {f.name for f in dataclasses.fields(cls)}
+    missing = names - host.keys()
+    if missing:
+        raise ValueError(f"checkpoint is missing fields: {sorted(missing)}")
+    extra = host.keys() - names
+    if extra:
+        raise ValueError(
+            f"checkpoint has unknown fields {sorted(extra)} — written by an "
+            f"incompatible {cls.__name__} schema?")
+    for k, shape in expect_shapes(host).items():
+        got = np.asarray(host[k]).shape
+        if got != shape:
+            raise ValueError(
+                f"checkpoint field {k!r} has shape {got}, expected {shape}")
+    return cls(**{k: jnp.asarray(host[k]) for k in names})
+
+
+def save_struct_checkpoint(state, path: str) -> None:
+    """Write a mid-run checkpoint (.npz) of a state struct.
+
+    The path is used verbatim (np.savez would append ".npz" to an
+    extension-less path, breaking the save/load round trip).
+    """
+    with open(path, "wb") as f:
+        np.savez(f, **struct_to_host(state))
+
+
+def load_struct_checkpoint(path: str, cls, expect_shapes):
+    with np.load(path) as z:
+        return struct_from_host({k: z[k] for k in z.files}, cls,
+                                expect_shapes)
+
+
+def _world_expect(host):
+    n = np.asarray(host["known"]).shape[0]
+    return {"tick": (), "in_group": (n,), "own_hb": (n,),
+            "known": (n, n), "hb": (n, n), "ts": (n, n),
+            "gossip": (n, n), "joinreq": (n,), "joinrep": (n,)}
+
+
 def state_to_host(state: WorldState) -> dict[str, np.ndarray]:
     """Device state -> plain numpy dict (for checkpointing / debugging)."""
-    return {f.name: np.asarray(getattr(state, f.name))
-            for f in dataclasses.fields(WorldState)}
+    return struct_to_host(state)
 
 
 def state_from_host(host: dict[str, np.ndarray]) -> WorldState:
@@ -193,39 +244,14 @@ def state_from_host(host: dict[str, np.ndarray]) -> WorldState:
     because the clock, the in-flight traffic, and the PRNG key are all
     part of the state (tests/test_checkpoint.py).
     """
-    names = {f.name for f in dataclasses.fields(WorldState)}
-    missing = names - host.keys()
-    if missing:
-        raise ValueError(f"checkpoint is missing fields: {sorted(missing)}")
-    extra = host.keys() - names
-    if extra:
-        raise ValueError(
-            f"checkpoint has unknown fields {sorted(extra)} — written by an "
-            "incompatible WorldState schema?")
-    n = np.asarray(host["known"]).shape[0]
-    expect = {"tick": (), "in_group": (n,), "own_hb": (n,),
-              "known": (n, n), "hb": (n, n), "ts": (n, n),
-              "gossip": (n, n), "joinreq": (n,), "joinrep": (n,)}
-    for k, shape in expect.items():
-        got = np.asarray(host[k]).shape
-        if got != shape:
-            raise ValueError(
-                f"checkpoint field {k!r} has shape {got}, expected {shape} "
-                f"(checkpoint written for N={n})")
-    return WorldState(**{k: jnp.asarray(host[k]) for k in names})
+    return struct_from_host(host, WorldState, _world_expect)
 
 
 def save_checkpoint(state: WorldState, path: str) -> None:
-    """Write a mid-run checkpoint (.npz) of the full simulation state.
-
-    The path is used verbatim (np.savez would append ".npz" to an
-    extension-less path, breaking the save/load round trip).
-    """
-    with open(path, "wb") as f:
-        np.savez(f, **state_to_host(state))
+    """Write a mid-run checkpoint (.npz) of the full simulation state."""
+    save_struct_checkpoint(state, path)
 
 
 def load_checkpoint(path: str) -> WorldState:
     """Load a checkpoint written by :func:`save_checkpoint`."""
-    with np.load(path) as z:
-        return state_from_host({k: z[k] for k in z.files})
+    return load_struct_checkpoint(path, WorldState, _world_expect)

@@ -147,3 +147,39 @@ def test_overlay_requires_power_of_two():
     cfg = _overlay_cfg(max_nnb=48)
     with pytest.raises(AssertionError, match="power of two"):
         make_overlay_tick(cfg)
+
+
+def test_overlay_checkpoint_resume_bit_identical(tmp_path):
+    """40+40 stitched run == uninterrupted 80-tick run, through a file
+    round trip (the schedule is closed-form in the absolute clock)."""
+    import dataclasses
+
+    from gossip_protocol_tpu.models.overlay import (
+        OverlayMetrics, load_overlay_checkpoint, overlay_state_from_host,
+        overlay_state_to_host, save_overlay_checkpoint)
+
+    cfg = _overlay_cfg(max_nnb=64, total_ticks=80, drop_msg=True,
+                       msg_drop_prob=0.1, drop_open_tick=10,
+                       drop_close_tick=70)
+    sim = OverlaySimulation(cfg)
+    full = sim.run()
+
+    first = sim.run(ticks=40)
+    p = tmp_path / "ov.ckpt"
+    save_overlay_checkpoint(first.final_state, str(p))
+    second = sim.run(resume_from=load_overlay_checkpoint(str(p)))
+
+    for f in dataclasses.fields(type(full.final_state)):
+        assert np.array_equal(np.asarray(getattr(full.final_state, f.name)),
+                              np.asarray(getattr(second.final_state, f.name))), f.name
+    for f in dataclasses.fields(OverlayMetrics):
+        a = np.asarray(getattr(full.metrics, f.name))
+        b = np.concatenate([np.asarray(getattr(first.metrics, f.name)),
+                            np.asarray(getattr(second.metrics, f.name))])
+        assert np.array_equal(a, b), f.name
+
+    # schema validation
+    d = overlay_state_to_host(first.final_state)
+    d.pop("hb")
+    with pytest.raises(ValueError, match="missing"):
+        overlay_state_from_host(d)
