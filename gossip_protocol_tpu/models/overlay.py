@@ -306,6 +306,16 @@ def exchange_mask(seed, t, fi, n):
     return (m % np.uint32(n - 1)).astype(jnp.int32) + 1
 
 
+def _pack_th(ts, hb):
+    """int32 pack of a winner's payload: (ts+1) << 12 | (hb+1).
+
+    Both fields are < 4095 (runs are capped at 4094 ticks and
+    heartbeats advance at most once per tick), so among equal
+    priority-key candidates the max packed value is the lexicographic
+    (ts, hb) maximum."""
+    return ((ts + 1) << 12) | (hb + 1)
+
+
 def _pack_key(seed, t, rows_u, ids, ts):
     """uint32 slot-priority key: freshness band | rotated tie | id+1.
 
@@ -370,6 +380,9 @@ def make_overlay_tick(cfg: SimConfig, comm=None):
         "(XOR partner exchange)"
     assert n + 1 < (1 << ID_BITS), \
         f"overlay supports N <= {1 << (ID_BITS - 1)}"
+    assert cfg.total_ticks <= 4094, \
+        "the packed (ts, hb) winner payload caps runs at 4094 ticks " \
+        "(the reference caps at MAX_TIME 3600, EmulNet.h:11)"
     p = comm.n_shards
     nl = n // p
     assert nl * p == n and nl & (nl - 1) == 0, \
@@ -459,35 +472,37 @@ def make_overlay_tick(cfg: SimConfig, comm=None):
         ], 1)   # (Nl, 3L+1); the per-slot in-flight flag is appended below
 
         # ---- merge phase: one dense (Nl, K, L+1) pass per partner --
+        # The winner's (ts, hb) travel as one packed int32
+        # ((ts+1) << 12 | hb+1; both < 4095 because runs are capped at
+        # 4094 ticks) so recovering them costs a single masked max —
+        # among equal-priority-key candidates the lexicographic
+        # (ts, hb) max wins, which the oracle mirrors.
         cur_key = jnp.where(ids0 >= 0,
                             _pack_key(seed, t, rows_u[:, None], ids0, ts0),
                             0)
         keymax = cur_key
-        ts_acc = jnp.where(ids0 >= 0, ts0, 0)
-        hb_acc = jnp.where(ids0 >= 0, hb0, 0)
+        p_acc = jnp.where(ids0 >= 0, _pack_th(ts0, hb0), 0)
         recv_cnt = jnp.zeros((), jnp.int32)
 
-        def merge_block(rows_u_b, keymax, ts_acc, hb_acc, c_id, c_ts, c_hb,
+        def merge_block(rows_u_b, keymax, p_acc, c_id, c_ts, c_hb,
                         valid):
             slot = (mix32(seed, rows_u_b[:, None],
                           c_id.astype(jnp.uint32)) % k).astype(jnp.int32)
             key = jnp.where(valid,
                             _pack_key(seed, t, rows_u_b[:, None], c_id, c_ts),
                             0)
+            p_cand = jnp.where(valid, _pack_th(c_ts, c_hb), 0)
             match = slot[:, None, :] == kk[None, :, None]   # (B, K, L+1)
             kf = (match * key[:, None, :]).max(2)
             sel = match & (key[:, None, :] == kf[:, :, None]) \
                 & (kf > 0)[:, :, None]
-            ts_f = jnp.where(sel, c_ts[:, None, :], 0).max(2)
-            hb_f = jnp.where(sel, c_hb[:, None, :], 0).max(2)
+            pf = jnp.where(sel, p_cand[:, None, :], 0).max(2)
             new_max = jnp.maximum(keymax, kf)
             same = kf == new_max
             was = keymax == new_max
-            ts_acc = jnp.where(
-                same, jnp.maximum(ts_f, jnp.where(was, ts_acc, 0)), ts_acc)
-            hb_acc = jnp.where(
-                same, jnp.maximum(hb_f, jnp.where(was, hb_acc, 0)), hb_acc)
-            return new_max, ts_acc, hb_acc
+            p_acc = jnp.where(
+                same, jnp.maximum(pf, jnp.where(was, p_acc, 0)), p_acc)
+            return new_max, p_acc
 
         # Row-block the (rows, K, L+1) broadcast intermediates: at 1M
         # peers a full-width pass is ~9 GB of transient, so process
@@ -497,14 +512,14 @@ def make_overlay_tick(cfg: SimConfig, comm=None):
         blk = nl // n_blocks
 
         def merge_candidates(carry, c_id, c_ts, c_hb, valid):
-            keymax, ts_acc, hb_acc = carry
+            keymax, p_acc = carry
             if n_blocks == 1:
-                return merge_block(rows_u, keymax, ts_acc, hb_acc,
+                return merge_block(rows_u, keymax, p_acc,
                                    c_id, c_ts, c_hb, valid)
             shp = lambda x: x.reshape((n_blocks, blk) + x.shape[1:])
             out = jax.lax.map(
                 lambda xs: merge_block(*xs),
-                (shp(rows_u), shp(keymax), shp(ts_acc), shp(hb_acc),
+                (shp(rows_u), shp(keymax), shp(p_acc),
                  shp(c_id), shp(c_ts), shp(c_hb), shp(valid)))
             return tuple(x.reshape((nl,) + x.shape[2:]) for x in out)
 
@@ -526,8 +541,8 @@ def make_overlay_tick(cfg: SimConfig, comm=None):
             valid = sent_flag[:, None] & proc_l[:, None] & (c_id >= 0) \
                 & (t - c_ts < t_remove) & (c_id != rows_g[:, None])
             recv_cnt += (sent_flag & proc_l).sum().astype(jnp.int32)
-            keymax, ts_acc, hb_acc = merge_candidates(
-                (keymax, ts_acc, hb_acc), c_id, c_ts, c_hb, valid)
+            keymax, p_acc = merge_candidates(
+                (keymax, p_acc), c_id, c_ts, c_hb, valid)
         recv_cnt = comm.psum(recv_cnt)
 
         # ---- JOINREP consumption (introducer's payload broadcast) --
@@ -545,8 +560,8 @@ def make_overlay_tick(cfg: SimConfig, comm=None):
         jc_hb = jnp.broadcast_to(j_hb, (nl, l + 1))
         j_valid = jrep_l[:, None] & (jc_id >= 0) & (t - jc_ts < t_remove) \
             & (jc_id != rows_g[:, None])
-        keymax, ts_acc, hb_acc = merge_candidates(
-            (keymax, ts_acc, hb_acc), jc_id, jc_ts, jc_hb, j_valid)
+        keymax, p_acc = merge_candidates(
+            (keymax, p_acc), jc_id, jc_ts, jc_hb, j_valid)
         in_group = in_group0 | jrep
 
         # ---- JOINREQ at the introducer -----------------------------
@@ -563,27 +578,22 @@ def make_overlay_tick(cfg: SimConfig, comm=None):
         q_match = q_slot[None, :] == kk[:, None]             # (K, N)
         q_kf = (q_match * q_key[None, :]).max(1)             # (K,)
         q_sel = q_match & (q_key[None, :] == q_kf[:, None]) & (q_kf > 0)[:, None]
-        q_ts = jnp.where(q_sel, t, 0).max(1)
-        q_hb = jnp.where(q_sel, 1, 0).max(1)
+        q_pf = jnp.where(q_sel.any(1), _pack_th(t, 1), 0)    # all (t, hb=1)
         on0 = comm.on_first_shard()
         row0_new = jnp.where(on0, jnp.maximum(keymax[0], q_kf), keymax[0])
         same0 = on0 & (q_kf == row0_new)
         was0 = keymax[0] == row0_new
-        ts0_row = jnp.where(same0,
-                            jnp.maximum(q_ts, jnp.where(was0, ts_acc[0], 0)),
-                            ts_acc[0])
-        hb0_row = jnp.where(same0,
-                            jnp.maximum(q_hb, jnp.where(was0, hb_acc[0], 0)),
-                            hb_acc[0])
+        p0_row = jnp.where(same0,
+                           jnp.maximum(q_pf, jnp.where(was0, p_acc[0], 0)),
+                           p_acc[0])
         keymax = keymax.at[0].set(row0_new)
-        ts_acc = ts_acc.at[0].set(ts0_row)
-        hb_acc = hb_acc.at[0].set(hb0_row)
+        p_acc = p_acc.at[0].set(p0_row)
         recv_cnt += jrep.sum().astype(jnp.int32) + jreq.sum().astype(jnp.int32)
 
         ids1 = jnp.where(keymax > 0,
                          (keymax & ID_MASK).astype(jnp.int32) - 1, -1)
-        ts1 = jnp.where(keymax > 0, ts_acc, 0)
-        hb1 = jnp.where(keymax > 0, hb_acc, 0)
+        ts1 = jnp.where(keymax > 0, (p_acc >> 12) - 1, 0)
+        hb1 = jnp.where(keymax > 0, (p_acc & 0xFFF) - 1, 0)
 
         # ---- nodeStart / rejoin (replicated vector math) -----------
         starting = (t == start) | rejoining
@@ -764,7 +774,7 @@ class OverlaySimulation:
         if cfg.model != "overlay":
             raise ValueError("OverlaySimulation requires cfg.model='overlay'")
         self.cfg = cfg
-        self._run = make_overlay_run(cfg)
+        make_overlay_run(cfg)   # pre-build/cache the full-length run
 
     def run(self, profile_dir=None, resume_from: OverlayState | None = None,
             ticks: int | None = None):
@@ -790,6 +800,8 @@ class OverlaySimulation:
             raise ValueError(
                 f"resume_from is at tick {first}, past total_ticks="
                 f"{cfg.total_ticks}")
+        if ticks is not None and ticks < 0:
+            raise ValueError(f"ticks must be >= 0, got {ticks}")
         t_end = cfg.total_ticks if ticks is None \
             else min(cfg.total_ticks, first + ticks)
         run = make_overlay_run(cfg, t_end - first)

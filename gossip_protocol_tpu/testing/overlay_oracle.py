@@ -19,7 +19,7 @@ from ..config import INTRODUCER, SimConfig
 from ..models.overlay import (BAND, EPOCH, ID_BITS, _SALT_CHURN,
                               _SALT_CHURN_TICK, _SALT_GOSSIP_DROP,
                               _SALT_JOINREP_DROP, _SALT_JOINREQ_DROP,
-                              _SALT_MASK, _TIE_BITS, resolved_dims)
+                              _SALT_MASK, _TIE_BITS, _pack_th, resolved_dims)
 from ..state import NEVER
 from ..utils.hash32 import mix32, threshold32
 
@@ -162,7 +162,12 @@ class OverlayOracle:
             if j != INTRODUCER:
                 cands[INTRODUCER].append((int(j), 1, t))
 
-        # merge: per-slot max of the packed key; ties merge max ts/hb
+        # merge: per-slot max of the packed priority key; among equal
+        # keys the winner payload is the max packed _pack_th(ts, hb)
+        # — the lexicographic (ts, hb) maximum, as on device
+        def pack_th(ts, hb):
+            return int(_pack_th(ts, hb))
+
         new_ids = self.ids.copy()
         new_hb = self.hb.copy()
         new_ts = self.ts.copy()
@@ -173,25 +178,24 @@ class OverlayOracle:
                     continue
                 sl = self.slot(r, j)
                 kkey = self.key(t, r, j, ts)
+                p = pack_th(ts, hb)
                 cur = best.get(sl)
                 if cur is None or kkey > cur[0]:
-                    best[sl] = [kkey, ts, hb]
+                    best[sl] = [kkey, p]
                 elif kkey == cur[0]:
-                    cur[1] = max(cur[1], ts)
-                    cur[2] = max(cur[2], hb)
-            for sl, (kkey, ts, hb) in best.items():
+                    cur[1] = max(cur[1], p)
+            for sl, (kkey, p) in best.items():
                 if self.ids[r, sl] >= 0:
                     ckey = self.key(t, r, int(self.ids[r, sl]),
                                     int(self.ts[r, sl]))
                     if ckey > kkey:
                         continue
                     if ckey == kkey:
-                        new_ts[r, sl] = max(int(self.ts[r, sl]), ts)
-                        new_hb[r, sl] = max(int(self.hb[r, sl]), hb)
-                        continue
+                        p = max(p, pack_th(int(self.ts[r, sl]),
+                                           int(self.hb[r, sl])))
                 new_ids[r, sl] = (kkey & ((1 << ID_BITS) - 1)) - 1
-                new_ts[r, sl] = ts
-                new_hb[r, sl] = hb
+                new_ts[r, sl] = (p >> 12) - 1
+                new_hb[r, sl] = (p & 0xFFF) - 1
 
         # nodeStart / rejoin
         starting = np.array([self.start_of(i) == t for i in range(n)]) | rejoining
