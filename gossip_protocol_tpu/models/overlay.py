@@ -402,10 +402,12 @@ def make_overlay_tick(cfg: SimConfig, comm=None):
         per index factor (_xor_factors), written as transpose-free
         einsums so each factor is a single MXU contraction.
 
-        Exactness matters: payload values go up to N-1 and HIGHEST
-        precision keeps the f32 contraction exact (the TPU default
-        truncates matmul inputs to bf16, which rounds ids >= 2^16 —
-        e.g. 65535 -> 65536 — and corrupts the tables)."""
+        Exactness matters: the TPU default truncates matmul inputs to
+        bf16, which rounds ids >= 2^16 (65535 -> 65536) and corrupts
+        the tables.  HIGHEST is required: HIGH (bf16x3) nominally
+        carries 24 mantissa bits but was measured NOT exact at 2^20-1
+        ids on this hardware (caught by the final_coverage corruption
+        guard at the 1M config)."""
         nf = len(factors)
         y = x.reshape(tuple(factors) + (x.shape[-1],))
         axes = _AX[:nf] + "D"
