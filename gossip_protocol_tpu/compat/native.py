@@ -56,6 +56,11 @@ def load(auto_build: bool = True):
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_double,
         ctypes.c_int, ctypes.c_uint64, ctypes.POINTER(ctypes.c_int32),
         ctypes.c_char_p]
+    lib.gp_run_scenario_churn.restype = ctypes.c_int
+    lib.gp_run_scenario_churn.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_double,
+        ctypes.c_int, ctypes.c_uint64, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_char_p]
     lib.gp_run_conf.restype = ctypes.c_int
     lib.gp_run_conf.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
                                 ctypes.c_char_p]
@@ -116,6 +121,39 @@ def run_scenario(n: int, single_failure: bool, drop_msg: bool,
     return lib.gp_run_scenario(n, int(single_failure), int(drop_msg),
                                drop_prob, total_ticks, seed, ft,
                                outdir.encode())
+
+
+def run_scenario_churn(n: int, single_failure: bool, drop_msg: bool,
+                       drop_prob: float, total_ticks: int, seed: int,
+                       fail_ticks: Optional[Sequence[int]] = None,
+                       rejoin_ticks: Optional[Sequence[int]] = None,
+                       outdir: str = ".") -> int:
+    """Churn variant: failed peers are wiped at their rejoin tick and
+    re-enter through the normal JOINREQ path (Schedule.rejoin_tick's
+    native twin)."""
+    lib = _require_lib()
+
+    def _ptr(ticks, name):
+        if ticks is None:
+            return None, None
+        arr = np.ascontiguousarray(ticks, np.int32)
+        if arr.shape != (n,):
+            raise ValueError(f"{name} must have shape ({n},), got {arr.shape}")
+        return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), arr
+
+    ft, keep1 = _ptr(fail_ticks, "fail_ticks")
+    rt, keep2 = _ptr(rejoin_ticks, "rejoin_ticks")
+    if keep1 is not None and keep2 is not None:
+        bad = (keep2 != np.iinfo(np.int32).max) & (keep2 <= keep1)
+        if bad.any():
+            # same rule the JAX schedule enforces (state.py): a rejoin
+            # at or before the fail tick collapses the failed window
+            raise ValueError(
+                f"rejoin_ticks must be > fail_ticks (violated at peers "
+                f"{np.flatnonzero(bad).tolist()})")
+    return lib.gp_run_scenario_churn(n, int(single_failure), int(drop_msg),
+                                     drop_prob, total_ticks, seed, ft, rt,
+                                     outdir.encode())
 
 
 def run_conf(conf_path: str, seed: int = 0, outdir: str = ".") -> int:

@@ -80,6 +80,14 @@ int Bus::Recv(int me, int tick,
   return delivered;
 }
 
+int Bus::Purge(int me) {
+  if (me < 0 || me >= next_id_) return 0;
+  int purged = static_cast<int>(inbox_[me].size());
+  inflight_ -= purged;
+  inbox_[me].clear();
+  return purged;
+}
+
 int Bus::RecvBounded(int me, int tick, uint8_t* out, size_t out_cap,
                      int* sizes, int sizes_cap, bool* more) {
   if (more != nullptr) *more = false;

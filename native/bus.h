@@ -77,6 +77,13 @@ class Bus {
   int RecvBounded(int me, int tick, uint8_t* out, size_t out_cap, int* sizes,
                   int sizes_cap, bool* more);
 
+  // Drop every queued message for `me`, silently (no accounting): the
+  // in-flight traffic of a failed peer.  The framework drops such
+  // traffic (the reference lets it rot in the shared buffer forever,
+  // EmulNet.cpp:151); with the churn extension a rejoined peer must
+  // come back to an empty inbox.  Returns the number purged.
+  int Purge(int me);
+
   // ENcleanup (EmulNet.cpp:184-220): dump msgcount.log.
   bool Cleanup(const std::string& outdir) const;
 

@@ -13,7 +13,8 @@ constexpr int kIntroducer = 0;  // join address id=1 -> index 0
                                 // (Application.cpp:209-217, EmulNet.cpp:74)
 }
 
-Engine::Engine(const Params& par, std::vector<int32_t> fail_ticks)
+Engine::Engine(const Params& par, std::vector<int32_t> fail_ticks,
+               std::vector<int32_t> rejoin_ticks)
     : par_(par),
       n_(par.n()),
       bus_(par.n(), par.total_ticks,
@@ -21,6 +22,7 @@ Engine::Engine(const Params& par, std::vector<int32_t> fail_ticks)
            par.seed),
       start_at_(n_),
       fail_at_(std::move(fail_ticks)),
+      rejoin_at_(std::move(rejoin_ticks)),
       failed_(n_, 0),
       in_group_(n_, 0),
       own_hb_(n_, 0),
@@ -48,6 +50,23 @@ Engine::Engine(const Params& par, std::vector<int32_t> fail_ticks)
     }
   }
   fail_at_.resize(n_, INT32_MAX);
+  rejoin_at_.resize(n_, INT32_MAX);
+}
+
+void Engine::WipeNode(int i) {
+  // initThisNode semantics (MP1Node.cpp:95-113) for a churn rejoin:
+  // empty member list, heartbeat 0, out of group, empty inbox — and
+  // the peer's in-flight backlog is dropped (Bus::Purge), matching the
+  // device engine's traffic-to-failed-receivers rule.
+  for (int j = 0; j < n_; ++j) {
+    known_[cell(i, j)] = 0;
+    hb_[cell(i, j)] = 0;
+    ts_[cell(i, j)] = 0;
+  }
+  in_group_[i] = 0;
+  own_hb_[i] = 0;
+  inbox_[i].clear();
+  bus_.Purge(i);
 }
 
 bool Engine::Run(const std::string& outdir, bool quiet) {
@@ -65,6 +84,16 @@ bool Engine::Run(const std::string& outdir, bool quiet) {
   }
 
   for (int t = 0; t < par_.total_ticks; ++t) {
+    // Churn wipe, before any traffic moves this tick: the rejoining
+    // peer is re-initialized and its backlog dropped, but it is still
+    // failed while processing tick t (the flag clears after the
+    // injection pass below, mirroring failed_at's fail < t <= rejoin
+    // window in state.py) — messages sent to it *during* tick t are
+    // legitimately delivered at t+1.
+    for (int i = 0; i < n_; ++i) {
+      if (rejoin_at_[i] == t) WipeNode(i);
+    }
+
     // Phase A — every started, live node drains its inbox
     // (forward order, Application.cpp:125-135).  Messages are staged and
     // handled in phase B, preserving the reference's recv-then-step split.
@@ -82,7 +111,7 @@ bool Engine::Run(const std::string& outdir, bool quiet) {
     // tick falls after its fail tick still sends its JOINREQ and is
     // admitted — then removed TREMOVE ticks later, never having gossiped.
     for (int i = n_ - 1; i >= 0; --i) {
-      if (t == start_at_[i]) {
+      if (t == start_at_[i] || t == rejoin_at_[i]) {
         NodeStart(log, i, t);
       } else if (failed_[i]) {
         continue;
@@ -109,6 +138,8 @@ bool Engine::Run(const std::string& outdir, bool quiet) {
                  t);
         log.Event(i, t, text);
         failed_[i] = 1;
+      } else if (rejoin_at_[i] == t) {
+        failed_[i] = 0;   // alive again from tick t+1 on
       }
     }
   }
@@ -269,6 +300,15 @@ extern "C" {
 int gp_run_scenario(int n, int single_failure, int drop_msg, double drop_prob,
                     int total_ticks, uint64_t seed, const int32_t* fail_ticks,
                     const char* outdir) {
+  return gp_run_scenario_churn(n, single_failure, drop_msg, drop_prob,
+                               total_ticks, seed, fail_ticks,
+                               /*rejoin_ticks=*/nullptr, outdir);
+}
+
+int gp_run_scenario_churn(int n, int single_failure, int drop_msg,
+                          double drop_prob, int total_ticks, uint64_t seed,
+                          const int32_t* fail_ticks,
+                          const int32_t* rejoin_ticks, const char* outdir) {
   gossip::Params par;
   par.max_nnb = n;
   par.single_failure = single_failure != 0;
@@ -276,9 +316,10 @@ int gp_run_scenario(int n, int single_failure, int drop_msg, double drop_prob,
   par.msg_drop_prob = drop_prob;
   par.total_ticks = total_ticks;
   par.seed = seed;
-  std::vector<int32_t> ft;
+  std::vector<int32_t> ft, rt;
   if (fail_ticks != nullptr) ft.assign(fail_ticks, fail_ticks + n);
-  gossip::Engine engine(par, std::move(ft));
+  if (rejoin_ticks != nullptr) rt.assign(rejoin_ticks, rejoin_ticks + n);
+  gossip::Engine engine(par, std::move(ft), std::move(rt));
   return engine.Run(outdir != nullptr ? outdir : ".") ? 0 : 1;
 }
 

@@ -32,7 +32,12 @@ class Engine {
   // derive the scenario schedule from params (single: one uniform victim;
   // multi: contiguous half-block — Application.cpp:181-196 semantics,
   // seeded PRNG instead of wall-clock rand()).
-  Engine(const Params& par, std::vector<int32_t> fail_ticks = {});
+  // rejoin_ticks: churn extension (absent in the reference, SURVEY.md
+  // §5): a failed peer is wiped at this tick and re-introduced through
+  // the normal JOINREQ path (must be > its fail tick; INT32_MAX =
+  // stays dead).  Twin of Schedule.rejoin_tick (state.py).
+  Engine(const Params& par, std::vector<int32_t> fail_ticks = {},
+         std::vector<int32_t> rejoin_ticks = {});
 
   // Run the full scenario, writing dbg.log / stats.log / msgcount.log
   // into outdir.  Returns false if the logs could not be opened.
@@ -42,6 +47,7 @@ class Engine {
   const std::vector<int32_t>& start_ticks() const { return start_at_; }
 
  private:
+  void WipeNode(int i);
   void NodeStart(LogSink& log, int i, int t);
   void CheckMessages(LogSink& log, int i, int t);
   void NodeLoopOps(LogSink& log, int i, int t);
@@ -58,6 +64,7 @@ class Engine {
   Bus bus_;
   std::vector<int32_t> start_at_;  // introduction tick per node
   std::vector<int32_t> fail_at_;   // failure tick per node (INT32_MAX = never)
+  std::vector<int32_t> rejoin_at_;  // churn rejoin tick (INT32_MAX = never)
 
   // SoA world state — the native mirror of state.py's WorldState.
   std::vector<uint8_t> failed_;    // [N]
@@ -78,6 +85,12 @@ extern "C" {
 int gp_run_scenario(int n, int single_failure, int drop_msg, double drop_prob,
                     int total_ticks, uint64_t seed, const int32_t* fail_ticks,
                     const char* outdir);
+// Churn variant: rejoin_ticks (may be NULL) wipes each failed peer at
+// its rejoin tick and re-introduces it through the JOINREQ path.
+int gp_run_scenario_churn(int n, int single_failure, int drop_msg,
+                          double drop_prob, int total_ticks, uint64_t seed,
+                          const int32_t* fail_ticks,
+                          const int32_t* rejoin_ticks, const char* outdir);
 // Same, parsing a reference-format .conf file. Returns 0 on success.
 int gp_run_conf(const char* conf_path, uint64_t seed, const char* outdir);
 }

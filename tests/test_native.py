@@ -145,7 +145,7 @@ def test_native_engine_detection_latency(lib, tmp_path):
 
 # ---- engine vs JAX engine: differential parity -----------------------
 
-def _jax_events(cfg, fail_ticks):
+def _jax_events(cfg, fail_ticks, rejoin_ticks=None):
     import jax.numpy as jnp
 
     from gossip_protocol_tpu.core.sim import Simulation
@@ -154,6 +154,8 @@ def _jax_events(cfg, fail_ticks):
     sim = Simulation(cfg)
     sched = make_schedule(cfg)
     sched = sched.replace(fail_tick=jnp.asarray(fail_ticks))
+    if rejoin_ticks is not None:
+        sched = sched.replace(rejoin_tick=jnp.asarray(rejoin_ticks))
     # re-run with the pinned schedule
     from gossip_protocol_tpu.state import init_state
     state = init_state(cfg)
@@ -316,3 +318,53 @@ def test_application_jax_backend_smoke(app_binary, tmp_path, testcases_dir):
     assert res.returncode == 0, res.stderr.decode()[-1000:]
     g = grade_single(str(tmp_path / "dbg.log"))
     assert g.points == 30, (tmp_path / "dbg.log").read_text()[:500]
+
+
+@pytest.mark.parametrize("rejoin_after", [40, 10])
+def test_native_vs_jax_churn_parity(lib, tmp_path, rejoin_after):
+    """The churn extension on both engines: a pinned fail+rejoin
+    schedule must produce the identical (observer, subject, tick) join
+    and removal event sets — covering both the late rejoin (peer was
+    removed, re-admitted with fresh join events) and the quick rejoin
+    (old entries refreshed in place, no removals at all)."""
+    from gossip_protocol_tpu.config import SimConfig
+
+    n, t_total = 16, 160
+    cfg = SimConfig(max_nnb=n, single_failure=True, drop_msg=False,
+                    seed=2, total_ticks=t_total, fail_tick=30,
+                    rejoin_after=rejoin_after)
+    fail = np.full(n, np.iinfo(np.int32).max, np.int32)
+    rejoin = np.full(n, np.iinfo(np.int32).max, np.int32)
+    fail[5] = 30
+    rejoin[5] = 30 + rejoin_after
+
+    rc = native.run_scenario_churn(n, True, False, 0.0, t_total, seed=2,
+                                   fail_ticks=fail, rejoin_ticks=rejoin,
+                                   outdir=str(tmp_path))
+    assert rc == 0
+    adds_native, rems_native = _parse_native_events(tmp_path / "dbg.log")
+
+    added, removed = _jax_events(cfg, fail, rejoin)
+    adds_jax = {(int(i), int(j), int(t)) for t, i, j in zip(*np.nonzero(added))}
+    rems_jax = {(int(i), int(j), int(t)) for t, i, j in zip(*np.nonzero(removed))}
+    assert adds_native == adds_jax
+    assert rems_native == rems_jax
+    if rejoin_after == 40:
+        # late rejoin: everyone removed the victim once and re-admitted it
+        assert any(subj == 5 and t > 70 for (_, subj, t) in adds_native)
+        assert {(obs, t) for (obs, subj, t) in rems_native if subj == 5}
+    else:
+        # quick rejoin inside TREMOVE: no removals at all
+        assert not rems_native
+
+
+def test_native_churn_rejects_collapsed_window(lib, tmp_path):
+    """rejoin <= fail is invalid (same rule as make_schedule)."""
+    fail = np.full(8, np.iinfo(np.int32).max, np.int32)
+    rejoin = np.full(8, np.iinfo(np.int32).max, np.int32)
+    fail[3] = 20
+    rejoin[3] = 20
+    with pytest.raises(ValueError, match="rejoin_ticks"):
+        native.run_scenario_churn(8, True, False, 0.0, 60, seed=0,
+                                  fail_ticks=fail, rejoin_ticks=rejoin,
+                                  outdir=str(tmp_path))
