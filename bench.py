@@ -78,11 +78,14 @@ def bench_overlay(n: int, ticks: int, drop: bool = False):
         cfg = SimConfig(max_nnb=n, model="overlay", single_failure=False,
                         drop_msg=False, seed=0, total_ticks=ticks,
                         churn_rate=0.2, rejoin_after=40, step_rate=64.0 / n)
-    sim = OverlaySimulation(cfg)
-    sim.run()                     # compile + warm
+    OverlaySimulation(cfg).run()          # compile + warm (seed 0)
     best = None
-    for _ in range(2):
-        res = sim.run()
+    for rep in range(2):
+        # distinct seeds per rep, never repeating the warmup's: the
+        # accelerator relay memoizes identical (executable, args)
+        # calls, which would fake the timing (see
+        # .claude/skills/verify/SKILL.md)
+        res = OverlaySimulation(cfg.replace(seed=rep + 1)).run()
         if best is None or res.wall_seconds < best.wall_seconds:
             best = res
     # validate before reporting: the number only counts if the run is
@@ -108,11 +111,11 @@ def bench_dense(n: int, ticks: int):
     cfg = SimConfig(max_nnb=n, single_failure=False, drop_msg=True,
                     msg_drop_prob=0.1, seed=0, total_ticks=ticks)
     sim = Simulation(cfg)
-    res = sim.run_bench()          # compiles on the warmup run
-    best = res
-    for _ in range(2):
-        r = sim.run_bench(warmup=False)
-        if r.wall_seconds < best.wall_seconds:
+    sim.run_bench()                # compiles on the warmup run; its
+    best = None                    # timed call repeats the warmup args
+    for rep in range(2):           # so discard it (relay memoization)
+        r = sim.run_bench(seed=rep + 1, warmup=False)
+        if best is None or r.wall_seconds < best.wall_seconds:
             best = r
     return best.node_ticks_per_second
 
