@@ -330,8 +330,14 @@ def _pack_key(seed, t, rows_u, ids, ts):
     band = (jnp.uint32(7) - (age // BAND).astype(jnp.uint32)) \
         << (ID_BITS + _TIE_BITS)
     epoch = (t // EPOCH).astype(jnp.uint32)
+    # the tie is the hash's top _TIE_BITS placed at bit ID_BITS — mask
+    # then one right shift, NOT (h >> 24) << 21: that shift pair
+    # miscompiles under Mosaic in the fused kernel's context (observed
+    # on v5e: small tie values land as 0), and the masked form is
+    # bit-identical algebra
+    tie_mask = jnp.uint32(((1 << _TIE_BITS) - 1) << (32 - _TIE_BITS))
     tie = (mix32(seed, epoch, rows_u, ids.astype(jnp.uint32))
-           >> (32 - _TIE_BITS)) << ID_BITS
+           & tie_mask) >> (32 - _TIE_BITS - ID_BITS)
     return band | tie | (ids + 1).astype(jnp.uint32)
 
 
@@ -362,7 +368,8 @@ class LocalOverlayComm:
         return v
 
 
-def make_overlay_tick(cfg: SimConfig, comm=None):
+def make_overlay_tick(cfg: SimConfig, comm=None,
+                      use_pallas: bool | None = None):
     """Build ``tick(state, sched) -> (state', OverlayMetrics)``.
 
     With the default :class:`LocalOverlayComm` this is a single-device
@@ -371,8 +378,21 @@ def make_overlay_tick(cfg: SimConfig, comm=None):
     exchange's shard-index bits become a ``ppermute``; all (N,) vectors
     stay replicated.  Both paths are bit-identical
     (tests/test_overlay_sharded.py).
+
+    ``use_pallas`` routes the exchange+merge hot phase through the
+    fused Pallas kernel (ops/pallas/overlay_exchange.py — single-device
+    path only).  The kernel is bit-identical to the XLA phases
+    (tests/test_overlay_pallas.py).  Default is currently OFF: with the
+    per-receiver slot hash both paths are VPU-bound on the same
+    (K, L+1) slot-match product, and the kernel's narrow per-candidate
+    ops measure slower than XLA's broadcast formulation (65k: 20ms vs
+    6.7ms/tick) — it becomes the fast path once the merge is
+    lane-aligned (epoch-slotted views).
     """
     comm = comm or LocalOverlayComm()
+    if use_pallas is None:
+        use_pallas = False
+    use_kernel = bool(use_pallas) and isinstance(comm, LocalOverlayComm)
     n = cfg.n
     k, l, f = resolved_dims(cfg)
     t_remove = cfg.t_remove
@@ -466,12 +486,22 @@ def make_overlay_tick(cfg: SimConfig, comm=None):
         idsw = jnp.roll(ids0, -off, axis=1)[:, :l]
         hbw = jnp.roll(hb0, -off, axis=1)[:, :l]
         tsw = jnp.roll(ts0, -off, axis=1)[:, :l]
-        payload = jnp.concatenate([
-            idsw.astype(jnp.float32),
-            hbw.astype(jnp.float32),
-            tsw.astype(jnp.float32),
-            own_hb0_l.astype(jnp.float32)[:, None],
-        ], 1)   # (Nl, 3L+1); the per-slot in-flight flag is appended below
+        if use_kernel:
+            # integer payload for the Pallas kernel: the butterfly
+            # moves rows without arithmetic, so no float casts (and no
+            # matmul-precision hazard) anywhere.  All F per-round send
+            # flags ride along as trailing columns.
+            payload = jnp.concatenate([
+                idsw, hbw, tsw, own_hb0_l[:, None],
+                state.send_flags.astype(jnp.int32),
+            ], 1)   # (Nl, 3L+1+F)
+        else:
+            payload = jnp.concatenate([
+                idsw.astype(jnp.float32),
+                hbw.astype(jnp.float32),
+                tsw.astype(jnp.float32),
+                own_hb0_l.astype(jnp.float32)[:, None],
+            ], 1)   # (Nl, 3L+1); the per-round in-flight flag is appended below
 
         # ---- merge phase: one dense (Nl, K, L+1) pass per partner --
         # The winner's (ts, hb) travel as one packed int32
@@ -525,26 +555,39 @@ def make_overlay_tick(cfg: SimConfig, comm=None):
                  shp(c_id), shp(c_ts), shp(c_hb), shp(valid)))
             return tuple(x.reshape((nl,) + x.shape[2:]) for x in out)
 
-        for fi in range(f):
-            mask = exchange_mask(seed, t - 1, fi, n)
-            flag_col = state.send_flags[:, fi].astype(jnp.float32)[:, None]
-            q = xor_perm(
-                jnp.concatenate([payload, flag_col], 1), mask)
-            partner = rows_g ^ mask
-            c_id = jnp.concatenate(
-                [q[:, :l].astype(jnp.int32), partner[:, None]], 1)
-            c_hb = jnp.concatenate(
-                [q[:, l:2 * l].astype(jnp.int32),
-                 q[:, 3 * l].astype(jnp.int32)[:, None]], 1)
-            c_ts = jnp.concatenate(
-                [q[:, 2 * l:3 * l].astype(jnp.int32),
-                 jnp.broadcast_to(t - 1, (nl, 1))], 1)
-            sent_flag = q[:, 3 * l + 1] > 0.5
-            valid = sent_flag[:, None] & proc_l[:, None] & (c_id >= 0) \
-                & (t - c_ts < t_remove) & (c_id != rows_g[:, None])
-            recv_cnt += (sent_flag & proc_l).sum().astype(jnp.int32)
-            keymax, p_acc = merge_candidates(
-                (keymax, p_acc), c_id, c_ts, c_hb, valid)
+        if use_kernel:
+            from ..ops.pallas.overlay_exchange import fused_exchange_merge
+            masks = jnp.stack([exchange_mask(seed, t - 1, fi, n)
+                               for fi in range(f)])
+            kmax_k, pacc_k, recv_row = fused_exchange_merge(
+                payload, cur_key, p_acc, masks, t, seed,
+                k=k, l=l, t_remove=t_remove)
+            # the kernel merges every row; discard non-processing
+            # receivers' accumulators (bit-equal to gating `valid`)
+            keymax = jnp.where(proc_l[:, None], kmax_k, keymax)
+            p_acc = jnp.where(proc_l[:, None], pacc_k, p_acc)
+            recv_cnt = (recv_row * proc_l.astype(jnp.int32)).sum()
+        else:
+            for fi in range(f):
+                mask = exchange_mask(seed, t - 1, fi, n)
+                flag_col = state.send_flags[:, fi].astype(jnp.float32)[:, None]
+                q = xor_perm(
+                    jnp.concatenate([payload, flag_col], 1), mask)
+                partner = rows_g ^ mask
+                c_id = jnp.concatenate(
+                    [q[:, :l].astype(jnp.int32), partner[:, None]], 1)
+                c_hb = jnp.concatenate(
+                    [q[:, l:2 * l].astype(jnp.int32),
+                     q[:, 3 * l].astype(jnp.int32)[:, None]], 1)
+                c_ts = jnp.concatenate(
+                    [q[:, 2 * l:3 * l].astype(jnp.int32),
+                     jnp.broadcast_to(t - 1, (nl, 1))], 1)
+                sent_flag = q[:, 3 * l + 1] > 0.5
+                valid = sent_flag[:, None] & proc_l[:, None] & (c_id >= 0) \
+                    & (t - c_ts < t_remove) & (c_id != rows_g[:, None])
+                recv_cnt += (sent_flag & proc_l).sum().astype(jnp.int32)
+                keymax, p_acc = merge_candidates(
+                    (keymax, p_acc), c_id, c_ts, c_hb, valid)
         recv_cnt = comm.psum(recv_cnt)
 
         # ---- JOINREP consumption (introducer's payload broadcast) --
@@ -675,16 +718,19 @@ def make_overlay_tick(cfg: SimConfig, comm=None):
 _OVERLAY_RUN_CACHE: dict = {}
 
 
-def make_overlay_run(cfg: SimConfig, length: int | None = None):
+def make_overlay_run(cfg: SimConfig, length: int | None = None,
+                     use_pallas: bool | None = None):
     """``lax.scan`` over ``length`` ticks (default: the whole run):
     ``run(state, sched) -> (final, metrics[length])``.  The schedule is
     closed-form in the absolute clock carried in the state, so a
     shorter scan resumes mid-run bit-identically."""
     length = cfg.total_ticks if length is None else length
-    key = (cfg.n, cfg.t_remove, length, resolved_dims(cfg))
+    if use_pallas is None:
+        use_pallas = False
+    key = (cfg.n, cfg.t_remove, length, resolved_dims(cfg), use_pallas)
     if key in _OVERLAY_RUN_CACHE:
         return _OVERLAY_RUN_CACHE[key]
-    tick = make_overlay_tick(cfg)
+    tick = make_overlay_tick(cfg, use_pallas=use_pallas)
 
     @jax.jit
     def run(state: OverlayState, sched: OverlaySchedule):
@@ -772,11 +818,12 @@ class OverlayResult:
 class OverlaySimulation:
     """Orchestrator for cfg.model == "overlay" runs (metrics mode)."""
 
-    def __init__(self, cfg: SimConfig):
+    def __init__(self, cfg: SimConfig, use_pallas: bool | None = None):
         if cfg.model != "overlay":
             raise ValueError("OverlaySimulation requires cfg.model='overlay'")
         self.cfg = cfg
-        make_overlay_run(cfg)   # pre-build/cache the full-length run
+        self.use_pallas = use_pallas
+        make_overlay_run(cfg, use_pallas=use_pallas)   # pre-build/cache
 
     def run(self, profile_dir=None, resume_from: OverlayState | None = None,
             ticks: int | None = None):
@@ -806,7 +853,7 @@ class OverlaySimulation:
             raise ValueError(f"ticks must be >= 0, got {ticks}")
         t_end = cfg.total_ticks if ticks is None \
             else min(cfg.total_ticks, first + ticks)
-        run = make_overlay_run(cfg, t_end - first)
+        run = make_overlay_run(cfg, t_end - first, use_pallas=self.use_pallas)
         t0 = time.perf_counter()
         final, metrics = run(state, sched)
         jax.block_until_ready(final)

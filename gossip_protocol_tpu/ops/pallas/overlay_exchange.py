@@ -1,0 +1,204 @@
+"""Fused Pallas TPU kernel: overlay XOR exchange + hash-slot merge.
+
+The overlay tick's hot phase (models/overlay.py) is, per exchange round
+``f``: permute the whole payload matrix by ``x[i ^ m_f]`` and fold the
+permuted candidate entries into the per-receiver hash-slotted view
+tables.  The XLA formulation pays for both halves:
+
+* the XOR permutation is two HIGHEST-precision f32 permutation matmuls
+  of O(sqrt(N)) contraction depth — O(N^1.5 * C) FLOPs that dominate
+  the tick at the 1M-peer BASELINE config;
+* the merge materializes (N, K, L+1) broadcast intermediates in HBM,
+  several GB of transient traffic per tick at 65k.
+
+This kernel does both in one launch with the permutation *free* and
+the merge VMEM-resident:
+
+* the shard-free high bits of ``i ^ m`` are folded into the grid's
+  **block index map** (block ``i`` DMAs source block ``i ^ (m >> lgB)``
+  — the mask is a scalar-prefetch argument, so the DMA address is
+  known before the body runs);
+* the low bits are a **butterfly network in VMEM**: for each set bit
+  ``j`` of ``m % B``, rows swap with their ``r ^ 2^j`` partner — a
+  static rotate + select per bit, exact integer moves (the f32
+  matmul's bf16-truncation hazard is gone by construction);
+* the hash-slot merge is a serial pass over the L+1 candidate columns,
+  each a lexicographic (key, payload) max into the (B, K) accumulators
+  held in the output refs, which stay VMEM-resident across the F grid
+  steps (the output block index ignores the round axis).
+
+Per tick the kernel reads the payload F times and the accumulators
+once — ~250 MB of HBM traffic at N=65536 versus the multi-GB XLA
+path, and no matmuls at all.
+
+Semantics are bit-identical to the XLA merge chain in
+models/overlay.py (same `_pack_key`/`_pack_th` contract, same
+candidate validity; lexicographic max is order-free, so fusing the
+rounds cannot change the winner).  Differentially tested in
+tests/test_overlay_pallas.py; the receiver-side ``proc`` gate and the
+JOINREQ/JOINREP merges stay outside (models/overlay.py applies them —
+the merge is commutative, so ordering is free).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _roll_rows(x, shift: int):
+    """Circular roll along axis 0 by a static shift (concat of static
+    slices — lowers unconditionally in Mosaic and interpret mode)."""
+    s = shift % x.shape[0]
+    if s == 0:
+        return x
+    return jnp.concatenate([x[-s:], x[:-s]], axis=0)
+
+
+def _kernel(b: int, c: int, k: int, l: int, f_rounds: int, t_remove: int,
+            # scalar prefetch: [t, seed, m_0 .. m_{F-1}]
+            sp_ref,
+            # inputs
+            payload_ref,                  # (B, C) block, pre-XOR'd high bits
+            curkey_ref, curp_ref,         # (B, K) accumulator init
+            # outputs (accumulated across the round axis)
+            kmax_ref, pacc_ref, recv_ref):
+    from ...models.overlay import _pack_key, _pack_th
+    from ...utils.hash32 import mix32
+
+    fi = pl.program_id(1)
+    i_blk = pl.program_id(0)
+
+    @pl.when(fi == 0)
+    def _init():
+        kmax_ref[:] = curkey_ref[:]
+        pacc_ref[:] = curp_ref[:]
+        recv_ref[:] = jnp.zeros_like(recv_ref)
+
+    t = sp_ref[0]
+    seed = sp_ref[1].astype(jnp.uint32)
+    m = sp_ref[2 + fi]
+
+    # ---- butterfly: finish the XOR permutation's low bits ----------
+    w = payload_ref[:]
+    rbits = jax.lax.broadcasted_iota(jnp.int32, (b, 1), 0)
+    lgb = b.bit_length() - 1
+    for j in range(lgb):
+        s = 1 << j
+        swapped = jnp.where(((rbits >> j) & 1) == 0,
+                            _roll_rows(w, -s), _roll_rows(w, s))
+        w = jnp.where(((m >> j) & 1) == 1, swapped, w)
+
+    # ---- candidate merge: lexicographic (key, packed ts/hb) max ----
+    rows = i_blk * b + rbits                       # (B, 1) global rows
+    rows_u = rows.astype(jnp.uint32)
+    partner = rows ^ m
+    # this round's send flag: fi is traced, so select the column with
+    # an iota compare instead of a dynamic lane slice
+    flags_all = w[:, 3 * l + 1:3 * l + 1 + f_rounds]            # (B, F)
+    fsel = jax.lax.broadcasted_iota(jnp.int32, (b, f_rounds), 1) == fi
+    flag = jnp.where(fsel, flags_all, 0).max(axis=1, keepdims=True) > 0
+    kk = jax.lax.broadcasted_iota(jnp.int32, (b, k), 1)
+
+    kmax = kmax_ref[:]
+    pacc = pacc_ref[:]
+    for cand in range(l + 1):
+        if cand < l:
+            c_id = w[:, cand:cand + 1]
+            c_hb = w[:, l + cand:l + cand + 1]
+            c_ts = w[:, 2 * l + cand:2 * l + cand + 1]
+            fresh = t - c_ts < t_remove
+        else:                              # the partner's self-entry
+            c_id = partner
+            c_hb = w[:, 3 * l:3 * l + 1]
+            c_ts = jnp.full_like(c_id, 0) + (t - 1)
+            # its age is exactly 1, so freshness is static in t_remove
+            fresh = t_remove > 1
+        valid = flag & (c_id >= 0) & fresh & (c_id != rows)
+        c_idu = c_id.astype(jnp.uint32)
+        slot = (mix32(seed, rows_u, c_idu) % k).astype(jnp.int32)
+        keyc = jnp.where(valid, _pack_key(seed, t, rows_u, c_id, c_ts),
+                         jnp.uint32(0))
+        pc = jnp.where(valid, _pack_th(c_ts, c_hb), 0)
+        match = slot == kk                           # (B, K)
+        ck = jnp.where(match, keyc, jnp.uint32(0))
+        cp = jnp.where(match, pc, 0)
+        better = (ck > kmax) | ((ck == kmax) & (cp > pacc))
+        kmax = jnp.where(better, ck, kmax)
+        pacc = jnp.where(better, cp, pacc)
+    kmax_ref[:] = kmax
+    pacc_ref[:] = pacc
+
+    lane0 = jax.lax.broadcasted_iota(jnp.int32, (b, 128), 1) == 0
+    recv_ref[:] = recv_ref[:] + jnp.where(lane0, flag.astype(jnp.int32), 0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "l", "t_remove", "block_rows",
+                                    "interpret"))
+def fused_exchange_merge(payload, cur_key, cur_p, masks, t, seed, *,
+                         k: int, l: int, t_remove: int,
+                         block_rows: int = 256,
+                         interpret: bool | None = None):
+    """All F exchange rounds' permute+merge in one Pallas launch.
+
+    Args:
+      payload: i32[N, 3L+1+F] — per sender row: L-window ids, hbs, tss,
+        own_hb, then the F per-round send flags (0/1).
+      cur_key/cur_p: u32/i32[N, K] — accumulators' initial value (the
+        receiver's current table keys, models/overlay.py).
+      masks: i32[F] — this tick's XOR masks ``m_f`` (all in [1, N)).
+      t, seed: the clock (i32) and hash seed (u32).
+
+    Returns ``(keymax u32[N, K], p_acc i32[N, K], recv i32[N])`` with
+    NO receiver-side ``proc`` gating — the caller selects
+    ``where(proc, result, initial)`` (bit-equal because an invalid
+    receiver's accumulator is simply discarded).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, c = payload.shape
+    f_rounds = int(masks.shape[0])
+    assert c == 3 * l + 1 + f_rounds, (c, l, f_rounds)
+    b = min(block_rows, n)
+    assert n % b == 0 and b & (b - 1) == 0 and b >= 8, (n, b)
+    nb = n // b
+
+    i32 = jnp.int32
+    sp = jnp.concatenate([
+        jnp.asarray([t], i32).reshape(1),
+        seed.astype(i32).reshape(1),
+        masks.astype(i32).reshape(f_rounds)])
+
+    row_block = lambda i, fi, sp_ref: (i, 0)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nb, f_rounds),
+        in_specs=[
+            pl.BlockSpec((b, c),
+                         lambda i, fi, sp_ref: (i ^ (sp_ref[2 + fi] // b), 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, k), row_block, memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, k), row_block, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((b, k), row_block, memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, k), row_block, memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, 128), row_block, memory_space=pltpu.VMEM),
+        ],
+    )
+    kmax, pacc, recv = pl.pallas_call(
+        functools.partial(_kernel, b, c, k, l, f_rounds, t_remove),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((n, k), jnp.uint32),
+            jax.ShapeDtypeStruct((n, k), i32),
+            jax.ShapeDtypeStruct((n, 128), i32),
+        ],
+        interpret=interpret,
+    )(sp, payload, cur_key, cur_p)
+    return kmax, pacc, recv[:, 0]

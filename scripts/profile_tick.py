@@ -1,0 +1,59 @@
+"""Whole-tick timing of the overlay model on the live backend.
+
+Times the tick through a ``lax.scan`` (single dispatches through this
+image's TPU relay cost ~100 ms, so only scans reflect device speed —
+see .claude/skills/verify/SKILL.md) for both the XLA and Pallas paths.
+Not part of the test suite; a development tool.
+
+Usage: python scripts/profile_tick.py [N]
+"""
+
+import sys
+import time
+
+import jax
+
+sys.path.insert(0, ".")
+
+from gossip_protocol_tpu.config import SimConfig
+from gossip_protocol_tpu.models.overlay import (init_overlay_state,
+                                                make_overlay_schedule,
+                                                make_overlay_tick,
+                                                resolved_dims)
+
+
+def scan_time(tick, state, sched, reps=3, length=50):
+    @jax.jit
+    def scanned(s):
+        def step(c, _):
+            return tick(c, sched)[0], None
+        return jax.lax.scan(step, s, None, length=length)[0]
+
+    jax.block_until_ready(scanned(state))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(scanned(state))
+        best = min(best, time.perf_counter() - t0)
+    return best / length
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+    print("backend:", jax.default_backend(), flush=True)
+    cfg = SimConfig(max_nnb=n, model="overlay", single_failure=False,
+                    drop_msg=False, seed=0, total_ticks=300,
+                    churn_rate=0.2, rejoin_after=40, step_rate=64.0 / n)
+    print(f"N={n} (K, L, F)={resolved_dims(cfg)}")
+    sched = make_overlay_schedule(cfg)
+    state = init_overlay_state(cfg)
+    length = 50 if n <= (1 << 17) else 10
+    for label, up in (("xla", False), ("pallas", True)):
+        dt = scan_time(make_overlay_tick(cfg, use_pallas=up), state, sched,
+                       length=length)
+        print(f"{label:7s} tick: {dt*1e3:8.3f} ms -> "
+              f"{n/dt/1e6:8.2f}M node-ticks/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

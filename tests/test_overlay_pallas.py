@@ -1,0 +1,84 @@
+"""Differential tests: fused overlay exchange+merge kernel vs XLA path.
+
+The Pallas kernel (ops/pallas/overlay_exchange.py) must be
+bit-identical to the composable XLA phases in models/overlay.py —
+state trajectories and metrics — across join ramp, scripted failure,
+drop window, and churn scenarios.  On CPU the kernel runs in
+interpret mode; the same contract holds compiled on TPU (exercised by
+bench.py and the profile harness there).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from gossip_protocol_tpu.config import SimConfig
+from gossip_protocol_tpu.models.overlay import (
+    init_overlay_state, make_overlay_schedule, make_overlay_tick)
+
+
+def _run_both(cfg, ticks):
+    sched = make_overlay_schedule(cfg)
+    tick_x = jax.jit(make_overlay_tick(cfg, use_pallas=False))
+    tick_p = jax.jit(make_overlay_tick(cfg, use_pallas=True))
+    sx = sp = init_overlay_state(cfg)
+    for _ in range(ticks):
+        sx, mx = tick_x(sx, sched)
+        sp, mp = tick_p(sp, sched)
+        yield sx, mx, sp, mp
+
+
+def _assert_state_equal(sx, sp, t):
+    for name in ("tick", "ids", "hb", "ts", "in_group", "own_hb",
+                 "send_flags", "joinreq", "joinrep"):
+        a = np.asarray(getattr(sx, name))
+        b = np.asarray(getattr(sp, name))
+        assert np.array_equal(a, b), \
+            f"state field {name} diverged at tick {t}"
+
+
+def _assert_metrics_equal(mx, mp, t):
+    for name in ("in_group", "view_slots", "adds", "removals",
+                 "false_removals", "victim_slots", "live_uncovered",
+                 "sent", "recv"):
+        a = int(np.asarray(getattr(mx, name)))
+        b = int(np.asarray(getattr(mp, name)))
+        assert a == b, f"metric {name} diverged at tick {t}: {a} != {b}"
+
+
+@pytest.mark.parametrize("n,scenario", [
+    (64, "ramp_fail"),
+    (128, "drop"),
+    (64, "churn"),
+])
+def test_kernel_bitwise_equals_xla(n, scenario):
+    if scenario == "ramp_fail":
+        cfg = SimConfig(max_nnb=n, model="overlay", single_failure=True,
+                        drop_msg=False, seed=3, total_ticks=120,
+                        fail_tick=40, step_rate=0.5)
+        ticks = 80
+    elif scenario == "drop":
+        cfg = SimConfig(max_nnb=n, model="overlay", single_failure=True,
+                        drop_msg=True, msg_drop_prob=0.3, seed=5,
+                        total_ticks=120, fail_tick=60, step_rate=0.25,
+                        drop_open_tick=10, drop_close_tick=100)
+        ticks = 80
+    else:
+        cfg = SimConfig(max_nnb=n, model="overlay", single_failure=False,
+                        drop_msg=False, seed=7, total_ticks=200,
+                        churn_rate=0.25, rejoin_after=30,
+                        step_rate=40.0 / n)
+        ticks = 160
+    for t, (sx, mx, sp, mp) in enumerate(_run_both(cfg, ticks)):
+        _assert_state_equal(sx, sp, t)
+        _assert_metrics_equal(mx, mp, t)
+
+
+def test_kernel_small_block_sizes():
+    """N smaller than the default block: one block, pure butterfly."""
+    cfg = SimConfig(max_nnb=32, model="overlay", single_failure=True,
+                    drop_msg=False, seed=11, total_ticks=80,
+                    fail_tick=30, step_rate=0.5)
+    for t, (sx, mx, sp, mp) in enumerate(_run_both(cfg, 60)):
+        _assert_state_equal(sx, sp, t)
+        _assert_metrics_equal(mx, mp, t)
