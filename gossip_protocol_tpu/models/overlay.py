@@ -7,7 +7,7 @@ it hard-caps at N<=10 (MP1Node.cpp:245) / N<=1000 (EmulNet.h:10).  The
 dense model in ``core/tick.py`` removes the caps but keeps O(N²) state,
 so BASELINE's 65k and 1M peer configs are unreachable by construction.
 This module is the scaling answer: a **bounded partial-view** membership
-protocol with O(N·K) state and O(N·F·L) work per tick.
+protocol with O(N·K) state and O(N·F·K) work per tick.
 
 Design: TPU-first, and specifically **gather/scatter/sort-free** — on
 TPU those lower to serialized index loops (measured ~75M indices/s,
@@ -21,20 +21,32 @@ hundreds of ms per tick at 65k), so every phase here is dense algebra:
   mixes like an expander.  Applying ``x[i ^ m]`` to the whole payload
   matrix is two small permutation **matmuls** (the XOR factors
   bitwise across a HI×LO index split), exact in f32 and riding the
-  MXU — no gather anywhere.  Payloads carry a rotating L-slot window
-  of the sender's view plus its self-entry, frozen at the send tick
-  (= the carried state, the dense model's zero-copy trick).
-* **View = per-receiver hash-slotted table.**  Node ``r`` can hold an
-  entry for peer ``j`` only in slot ``h(r, j) = mix32(r, j) % K``
-  (utils/hash32.py).  Collisions contend; the winner of a slot is the
-  entry with the largest packed uint32 key — freshness band first,
-  then an **epoch-rotated per-receiver tiebreak** — evaluated as a
-  dense (N, K, L+1) masked max per partner (K and L are small static
-  constants, so the "scatter" is a masked reduction).  The rotation is
-  load-bearing: a sticky max-(ts, id) key freezes view composition,
-  freshness waves stop reaching peripheral holders, and live entries
-  age out as false removals.  With rotation, views continuously
-  reshuffle (the TPU-shaped analog of Cyclon-style view exchange).
+  MXU — no gather anywhere.  (The Pallas kernel does the same
+  permutation for free: high mask bits in the block index map, low
+  bits as an in-VMEM butterfly.)  Payloads carry the sender's whole
+  K-slot view plus its self-entry, frozen at the send tick (= the
+  carried state, the dense model's zero-copy trick).
+* **View = epoch-slotted table, lane-aligned merges.**  An entry for
+  peer ``j`` lives only in slot ``g_e(j) = mix32(e, j) % K``, where
+  ``e = t // SLOT_EPOCH`` — the slot map is **shared by every node**
+  and re-rolled every SLOT_EPOCH ticks.  Because sender and receiver
+  tables are slotted identically within an epoch, merging an incoming
+  view is a pure **lane-aligned (N, K) masked max** — no K×L
+  slot-match product (the per-receiver-hash design this replaces paid
+  an O(K·L) broadcast per partner; this one is O(K), ~8x less VPU
+  work).  At each SLOT_EPOCH boundary every node re-slots its own
+  table once (an O(K²) contention pass amortized over SLOT_EPOCH
+  ticks, skipped on all other ticks via ``lax.cond``).
+* **Contention is rotated, per receiver.**  Slot collisions (ids with
+  equal ``g_e``) contend; the winner is the largest packed uint32
+  key — freshness band first, then an **epoch-rotated per-receiver
+  tiebreak** (``mix32(t // EPOCH, receiver, id)``).  The per-receiver
+  tie is load-bearing twice over: it keeps view composition
+  reshuffling (the TPU-shaped analog of Cyclon view exchange), and it
+  decorrelates the *global* slot collisions — colliding ids win at
+  different receivers, so every live id keeps holders somewhere, and
+  the SLOT_EPOCH re-roll retires any collision pair after at most one
+  epoch.
 * **Freshness is the priority.**  A live node stamps its own entry
   ``(id, own_hb, now)`` into every payload; the banded max-merge
   propagates the freshest observation along exchange paths, so an
@@ -61,8 +73,8 @@ framework's scaling extension): receivers adopt the freshest (ts, hb)
 observation instead of the increment-on-direct-gossip quirk
 (MP1Node.cpp:236-239); views are bounded, so entries can be evicted by
 slot contention; dissemination follows the XOR schedule rather than
-"send to everyone I know"; payloads are sampled windows, not full
-lists.
+"send to everyone I know"; messages carry a K-entry view, not the
+unbounded full list.
 """
 
 from __future__ import annotations
@@ -92,6 +104,11 @@ BAND = 4
 EPOCH = 4
 _TIE_BITS = 8
 
+#: global slot map re-roll period (ticks).  Long enough to amortize the
+#: O(K²) re-slot pass, short enough that a slot collision between two
+#: live ids never persists past ~one TREMOVE horizon.
+SLOT_EPOCH = 16
+
 # salts for the independent counter-hash streams
 _SALT_MASK = 1
 _SALT_GOSSIP_DROP = 2
@@ -99,6 +116,7 @@ _SALT_JOINREQ_DROP = 3
 _SALT_JOINREP_DROP = 4
 _SALT_CHURN = 5
 _SALT_CHURN_TICK = 6
+_SALT_SLOT = 7
 
 
 @struct.dataclass
@@ -245,31 +263,28 @@ class OverlayMetrics:
 #: track the live-coverage histogram on device only below this N
 COVERAGE_N_LIMIT = 4096
 
-#: merge pass row-block size (bounds the (B, K, L+1) broadcast
-#: intermediates; see merge_candidates)
-MERGE_BLOCK = 1 << 16
+#: re-slot pass row-block size (bounds the (B, K, K) contention
+#: broadcast at SLOT_EPOCH boundaries)
+REMAP_BLOCK = 1 << 13
 
 
 def resolved_dims(cfg: SimConfig):
-    """(K, L, F): view slots, payload window, exchange fanout.
+    """(K, F): view slots and exchange fanout.
 
-    Auto sizing: K ~ 4*log2 N for connectivity (capped at 64), payload
-    window L = K/2, and fanout chosen so the per-slot candidate supply
-    F*(L+1)/K is ~3.2 per tick — enough that slot refresh/eviction
-    outpaces the TREMOVE horizon even in the hash-popularity tail and
-    under a 10% drop window (empirically: supply 3.2 keeps the
-    false-removal rate ~1e-5/entry-tick at 65k; supply ~2 reaches
-    ~2e-4, still an order under the test bound).
+    Auto sizing: K ~ 4*log2 N for view capacity (capped at 64).  Every
+    message carries the sender's whole K-slot view (lane-aligned
+    merges), so each exchange supplies ~1 candidate per occupied slot
+    and the per-slot supply per tick is ~F·occupancy — F = 4 keeps
+    slot refresh ahead of the TREMOVE horizon with margin for a 10%
+    drop window.  ``cfg.overlay_sample`` (the L-window of the earlier
+    per-receiver-hash design) is accepted but ignored.
     """
     n = cfg.n
     b = int(math.ceil(math.log2(max(n, 4))))
     k = cfg.overlay_view if cfg.overlay_view > 0 \
         else min(64, max(16, 8 * ((b + 1) // 2)))
-    l = min(cfg.overlay_sample, k) if cfg.overlay_sample > 0 \
-        else min(k, max(4, k // 2))
-    f = cfg.fanout if cfg.fanout > 0 \
-        else max(3, -(-16 * k // (5 * (l + 1))))
-    return k, l, f
+    f = cfg.fanout if cfg.fanout > 0 else 4
+    return k, f
 
 
 def _xor_factors(n: int):
@@ -285,7 +300,7 @@ def _xor_factors(n: int):
 
 def init_overlay_state(cfg: SimConfig) -> OverlayState:
     n = cfg.n
-    k, l, f = resolved_dims(cfg)
+    k, f = resolved_dims(cfg)
     return OverlayState(
         tick=jnp.int32(0),
         ids=jnp.full((n, k), -1, jnp.int32),
@@ -316,6 +331,17 @@ def _pack_th(ts, hb):
     return ((ts + 1) << 12) | (hb + 1)
 
 
+def _slot_of(seed, slot_epoch_u, ids, k):
+    """Global slot of subject ``ids`` during a slot epoch.
+
+    The map is shared by every node (NOT receiver-hashed) and re-rolled
+    every SLOT_EPOCH ticks, so identically-slotted tables merge
+    lane-aligned; per-receiver diversity lives in the key's tie field.
+    """
+    return (mix32(seed, slot_epoch_u, ids.astype(jnp.uint32),
+                  np.uint32(_SALT_SLOT)) % k).astype(jnp.int32)
+
+
 def _pack_key(seed, t, rows_u, ids, ts):
     """uint32 slot-priority key: freshness band | rotated tie | id+1.
 
@@ -339,6 +365,29 @@ def _pack_key(seed, t, rows_u, ids, ts):
     tie = (mix32(seed, epoch, rows_u, ids.astype(jnp.uint32))
            & tie_mask) >> (32 - _TIE_BITS - ID_BITS)
     return band | tie | (ids + 1).astype(jnp.uint32)
+
+
+#: saturated tie field — see _pack_key_direct
+_TIE_MAX = ((1 << _TIE_BITS) - 1) << ID_BITS
+
+
+def _pack_key_direct(t, ids, ts):
+    """Key of a DIRECT observation: a subject's own self-entry (the
+    partner / introducer-reply entry) or its JOINREQ.
+
+    The tie field is saturated, so a direct entry outranks every
+    same-band hashed-tie rival: each live sender deterministically
+    (re)seeds itself at its F partners every tick, which closes the
+    transient union-coverage gaps that receiver-rotated contention
+    alone leaves open (a hashed tie can lose a slot at every current
+    holder simultaneously for a few ticks).  The boost exists only at
+    candidate time — once stored, the entry is ranked by the normal
+    hashed key, so slots do not freeze.
+    """
+    age = jnp.clip(t - ts, 0, 8 * BAND - 1)
+    band = (jnp.uint32(7) - (age // BAND).astype(jnp.uint32)) \
+        << (ID_BITS + _TIE_BITS)
+    return band | jnp.uint32(_TIE_MAX) | (ids + 1).astype(jnp.uint32)
 
 
 class LocalOverlayComm:
@@ -381,20 +430,17 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
 
     ``use_pallas`` routes the exchange+merge hot phase through the
     fused Pallas kernel (ops/pallas/overlay_exchange.py — single-device
-    path only).  The kernel is bit-identical to the XLA phases
-    (tests/test_overlay_pallas.py).  Default is currently OFF: with the
-    per-receiver slot hash both paths are VPU-bound on the same
-    (K, L+1) slot-match product, and the kernel's narrow per-candidate
-    ops measure slower than XLA's broadcast formulation (65k: 20ms vs
-    6.7ms/tick) — it becomes the fast path once the merge is
-    lane-aligned (epoch-slotted views).
+    path only; None = auto: on for TPU backends).  The kernel is
+    bit-identical to the XLA phases (tests/test_overlay_pallas.py) and
+    measured faster on v5e (per tick: ~3.4ms vs ~4.3ms at 65k, ~57ms
+    vs ~106ms at 1M — scripts/profile_tick.py, 200-tick scans).
     """
     comm = comm or LocalOverlayComm()
     if use_pallas is None:
-        use_pallas = False
+        use_pallas = jax.default_backend() == "tpu"
     use_kernel = bool(use_pallas) and isinstance(comm, LocalOverlayComm)
     n = cfg.n
-    k, l, f = resolved_dims(cfg)
+    k, f = resolved_dims(cfg)
     t_remove = cfg.t_remove
     assert n & (n - 1) == 0, "overlay peer count must be a power of two " \
         "(XOR partner exchange)"
@@ -479,81 +525,77 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
         own_hb0_l = comm.slice_rows(own_hb0)
 
         # ---- payload of the send tick t-1 --------------------------
-        # rotating L-slot window (covers the view every K/L ticks) +
-        # the sender's self-entry; all from carried state = frozen at
-        # the end of tick t-1
-        off = (((t - 1) * l) % k + k) % k
-        idsw = jnp.roll(ids0, -off, axis=1)[:, :l]
-        hbw = jnp.roll(hb0, -off, axis=1)[:, :l]
-        tsw = jnp.roll(ts0, -off, axis=1)[:, :l]
+        # the sender's whole K-slot view + its self-entry, all from
+        # carried state = frozen at the end of tick t-1 (whose table
+        # layout epoch is t // SLOT_EPOCH — the re-slot pass runs at
+        # the END of a boundary tick, so sender and receiver tables
+        # are always identically slotted within a tick)
+        slot_ep = (t // SLOT_EPOCH).astype(jnp.uint32)
+        # Entries travel as two words per slot — the subject id and the
+        # packed (ts, hb) payload word (exactly the merge's `p` value),
+        # which halves the permutation width vs separate hb/ts planes.
+        p0 = jnp.where(ids0 >= 0, _pack_th(ts0, hb0), 0)
         if use_kernel:
             # integer payload for the Pallas kernel: the butterfly
             # moves rows without arithmetic, so no float casts (and no
             # matmul-precision hazard) anywhere.  All F per-round send
             # flags ride along as trailing columns.
             payload = jnp.concatenate([
-                idsw, hbw, tsw, own_hb0_l[:, None],
+                ids0, p0, own_hb0_l[:, None],
                 state.send_flags.astype(jnp.int32),
-            ], 1)   # (Nl, 3L+1+F)
+            ], 1)   # (Nl, 2K+1+F)
         else:
             payload = jnp.concatenate([
-                idsw.astype(jnp.float32),
-                hbw.astype(jnp.float32),
-                tsw.astype(jnp.float32),
+                ids0.astype(jnp.float32),
+                p0.astype(jnp.float32),   # < 2^24, f32-exact
                 own_hb0_l.astype(jnp.float32)[:, None],
-            ], 1)   # (Nl, 3L+1); the per-round in-flight flag is appended below
+            ], 1)   # (Nl, 2K+1); the per-round in-flight flag is appended below
 
-        # ---- merge phase: one dense (Nl, K, L+1) pass per partner --
-        # The winner's (ts, hb) travel as one packed int32
-        # ((ts+1) << 12 | hb+1; both < 4095 because runs are capped at
-        # 4094 ticks) so recovering them costs a single masked max —
-        # among equal-priority-key candidates the lexicographic
-        # (ts, hb) max wins, which the oracle mirrors.
+        # ---- merge phase: lane-aligned (Nl, K) max per partner -----
+        # Incoming tables are slotted by the same global map, so the
+        # merge is a plain per-lane lexicographic (key, payload) max —
+        # no slot-match product.  The winner's (ts, hb) travel as one
+        # packed int32 ((ts+1) << 12 | hb+1; both < 4095 because runs
+        # are capped at 4094 ticks); among equal-priority-key
+        # candidates the lexicographic (ts, hb) max wins, which the
+        # oracle mirrors.
         cur_key = jnp.where(ids0 >= 0,
                             _pack_key(seed, t, rows_u[:, None], ids0, ts0),
                             0)
         keymax = cur_key
-        p_acc = jnp.where(ids0 >= 0, _pack_th(ts0, hb0), 0)
+        p_acc = p0
         recv_cnt = jnp.zeros((), jnp.int32)
 
-        def merge_block(rows_u_b, keymax, p_acc, c_id, c_ts, c_hb,
-                        valid):
-            slot = (mix32(seed, rows_u_b[:, None],
-                          c_id.astype(jnp.uint32)) % k).astype(jnp.int32)
+        def lex_merge(keymax, p_acc, key_c, p_c):
+            better = (key_c > keymax) | ((key_c == keymax) & (p_c > p_acc))
+            return (jnp.where(better, key_c, keymax),
+                    jnp.where(better, p_c, p_acc))
+
+        def table_merge(keymax, p_acc, c_id, c_ts, c_p, valid):
+            """Merge an identically-slotted (Nl, K) view, lane-aligned.
+
+            ``c_p`` is the already-packed (ts, hb) payload word — the
+            wire format and the merge tiebreak value coincide."""
             key = jnp.where(valid,
-                            _pack_key(seed, t, rows_u_b[:, None], c_id, c_ts),
-                            0)
-            p_cand = jnp.where(valid, _pack_th(c_ts, c_hb), 0)
-            match = slot[:, None, :] == kk[None, :, None]   # (B, K, L+1)
-            kf = (match * key[:, None, :]).max(2)
-            sel = match & (key[:, None, :] == kf[:, :, None]) \
-                & (kf > 0)[:, :, None]
-            pf = jnp.where(sel, p_cand[:, None, :], 0).max(2)
-            new_max = jnp.maximum(keymax, kf)
-            same = kf == new_max
-            was = keymax == new_max
-            p_acc = jnp.where(
-                same, jnp.maximum(pf, jnp.where(was, p_acc, 0)), p_acc)
-            return new_max, p_acc
+                            _pack_key(seed, t, rows_u[:, None], c_id, c_ts),
+                            jnp.uint32(0))
+            return lex_merge(keymax, p_acc, key,
+                             jnp.where(valid, c_p, 0))
 
-        # Row-block the (rows, K, L+1) broadcast intermediates: at 1M
-        # peers a full-width pass is ~9 GB of transient, so process
-        # MERGE_BLOCK rows at a time (lax.map keeps peak memory at one
-        # block while still emitting full-width outputs).
-        n_blocks = max(1, nl // MERGE_BLOCK)
-        blk = nl // n_blocks
+        def entry_merge(keymax, p_acc, subj, e_ts, e_hb, ok):
+            """Merge one DIRECT (subject, ts, hb) entry per local row."""
+            sl = _slot_of(seed, slot_ep, subj, k)
+            key = jnp.where(ok, _pack_key_direct(t, subj, e_ts),
+                            jnp.uint32(0))
+            p = jnp.where(ok, _pack_th(e_ts, e_hb), 0)
+            match = sl[:, None] == kk[None, :]
+            return lex_merge(keymax, p_acc,
+                             jnp.where(match, key[:, None], jnp.uint32(0)),
+                             jnp.where(match, p[:, None], 0))
 
-        def merge_candidates(carry, c_id, c_ts, c_hb, valid):
-            keymax, p_acc = carry
-            if n_blocks == 1:
-                return merge_block(rows_u, keymax, p_acc,
-                                   c_id, c_ts, c_hb, valid)
-            shp = lambda x: x.reshape((n_blocks, blk) + x.shape[1:])
-            out = jax.lax.map(
-                lambda xs: merge_block(*xs),
-                (shp(rows_u), shp(keymax), shp(p_acc),
-                 shp(c_id), shp(c_ts), shp(c_hb), shp(valid)))
-            return tuple(x.reshape((nl,) + x.shape[2:]) for x in out)
+        # the partner self-entry's age is exactly 1 tick, so its
+        # freshness gate is static in t_remove
+        self_entry_fresh = t_remove > 1
 
         if use_kernel:
             from ..ops.pallas.overlay_exchange import fused_exchange_merge
@@ -561,7 +603,7 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
                                for fi in range(f)])
             kmax_k, pacc_k, recv_row = fused_exchange_merge(
                 payload, cur_key, p_acc, masks, t, seed,
-                k=k, l=l, t_remove=t_remove)
+                k=k, t_remove=t_remove)
             # the kernel merges every row; discard non-processing
             # receivers' accumulators (bit-equal to gating `valid`)
             keymax = jnp.where(proc_l[:, None], kmax_k, keymax)
@@ -574,39 +616,39 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
                 q = xor_perm(
                     jnp.concatenate([payload, flag_col], 1), mask)
                 partner = rows_g ^ mask
-                c_id = jnp.concatenate(
-                    [q[:, :l].astype(jnp.int32), partner[:, None]], 1)
-                c_hb = jnp.concatenate(
-                    [q[:, l:2 * l].astype(jnp.int32),
-                     q[:, 3 * l].astype(jnp.int32)[:, None]], 1)
-                c_ts = jnp.concatenate(
-                    [q[:, 2 * l:3 * l].astype(jnp.int32),
-                     jnp.broadcast_to(t - 1, (nl, 1))], 1)
-                sent_flag = q[:, 3 * l + 1] > 0.5
-                valid = sent_flag[:, None] & proc_l[:, None] & (c_id >= 0) \
-                    & (t - c_ts < t_remove) & (c_id != rows_g[:, None])
-                recv_cnt += (sent_flag & proc_l).sum().astype(jnp.int32)
-                keymax, p_acc = merge_candidates(
-                    (keymax, p_acc), c_id, c_ts, c_hb, valid)
+                in_ids = q[:, :k].astype(jnp.int32)
+                in_p = q[:, k:2 * k].astype(jnp.int32)
+                in_ts = (in_p >> 12) - 1
+                own_p = q[:, 2 * k].astype(jnp.int32)
+                sent_flag = q[:, 2 * k + 1] > 0.5
+                ok = sent_flag & proc_l
+                valid = ok[:, None] & (in_ids >= 0) \
+                    & (t - in_ts < t_remove) & (in_ids != rows_g[:, None])
+                recv_cnt += ok.sum().astype(jnp.int32)
+                keymax, p_acc = table_merge(
+                    keymax, p_acc, in_ids, in_ts, in_p, valid)
+                if self_entry_fresh:
+                    keymax, p_acc = entry_merge(
+                        keymax, p_acc, partner,
+                        jnp.broadcast_to(t - 1, (nl,)), own_p, ok)
         recv_cnt = comm.psum(recv_cnt)
 
         # ---- JOINREP consumption (introducer's payload broadcast) --
         jrep = state.joinrep & proc
         jrep_l = comm.slice_rows(jrep)
-        bc = comm.bcast_row0(payload)                # (3L+1,) introducer row
-        j_id = jnp.concatenate([bc[:l].astype(jnp.int32),
-                                jnp.array([INTRODUCER], jnp.int32)])
-        j_hb = jnp.concatenate([bc[l:2 * l].astype(jnp.int32),
-                                bc[3 * l].astype(jnp.int32)[None]])
-        j_ts = jnp.concatenate([bc[2 * l:3 * l].astype(jnp.int32),
-                                (t - 1)[None]])
-        jc_id = jnp.broadcast_to(j_id, (nl, l + 1))
-        jc_ts = jnp.broadcast_to(j_ts, (nl, l + 1))
-        jc_hb = jnp.broadcast_to(j_hb, (nl, l + 1))
-        j_valid = jrep_l[:, None] & (jc_id >= 0) & (t - jc_ts < t_remove) \
-            & (jc_id != rows_g[:, None])
-        keymax, p_acc = merge_candidates(
-            (keymax, p_acc), jc_id, jc_ts, jc_hb, j_valid)
+        bc = comm.bcast_row0(payload)                # (2K+1,) introducer row
+        b_ids = jnp.broadcast_to(bc[:k].astype(jnp.int32), (nl, k))
+        b_p = jnp.broadcast_to(bc[k:2 * k].astype(jnp.int32), (nl, k))
+        b_ts = (b_p >> 12) - 1
+        j_valid = jrep_l[:, None] & (b_ids >= 0) & (t - b_ts < t_remove) \
+            & (b_ids != rows_g[:, None])
+        keymax, p_acc = table_merge(keymax, p_acc, b_ids, b_ts, b_p, j_valid)
+        if self_entry_fresh:
+            intro_vec = jnp.broadcast_to(jnp.int32(INTRODUCER), (nl,))
+            keymax, p_acc = entry_merge(
+                keymax, p_acc, intro_vec, jnp.broadcast_to(t - 1, (nl,)),
+                jnp.broadcast_to(bc[2 * k].astype(jnp.int32), (nl,)),
+                jrep_l & (intro_vec != rows_g))
         in_group = in_group0 | jrep
 
         # ---- JOINREQ at the introducer -----------------------------
@@ -615,11 +657,10 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
         # MP1Node.cpp:265-280)
         jreq = state.joinreq & proc[INTRODUCER]
         rows_gu_all = rows.astype(jnp.uint32)
-        q_slot = (mix32(seed, jnp.uint32(INTRODUCER), rows_gu_all) % k) \
-            .astype(jnp.int32)
+        q_slot = _slot_of(seed, slot_ep, rows, k)
         q_key = jnp.where(jreq & ~intro_onehot,
-                          _pack_key(seed, t, jnp.uint32(INTRODUCER), rows,
-                                    jnp.broadcast_to(t, (n,))), 0)
+                          _pack_key_direct(t, rows,
+                                           jnp.broadcast_to(t, (n,))), 0)
         q_match = q_slot[None, :] == kk[:, None]             # (K, N)
         q_kf = (q_match * q_key[None, :]).max(1)             # (K,)
         q_sel = q_match & (q_key[None, :] == q_kf[:, None]) & (q_kf > 0)[:, None]
@@ -666,6 +707,51 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
         ids2 = jnp.where(stale, -1, ids1)
         hb2 = jnp.where(stale, 0, hb1)
         ts2 = jnp.where(stale, 0, ts1)
+        ids_pre = ids2          # pre-re-roll table for cell-aligned metrics
+
+        # ---- slot-map re-roll at the SLOT_EPOCH boundary -----------
+        # Every node re-slots its surviving entries into the next
+        # epoch's global map in one (Nl, K, K) contention pass —
+        # collisions resolved by the same lexicographic (key, payload)
+        # rule as any merge.  Runs on 1/SLOT_EPOCH of ticks
+        # (lax.cond); row-blocked so the broadcast stays bounded at
+        # large N.  Applies to every row (layout is global, not
+        # protocol activity), so per-tick table metrics above describe
+        # the pre-re-roll table on boundary ticks.
+        next_ep = ((t + 1) // SLOT_EPOCH).astype(jnp.uint32)
+
+        def reslot(tabs):
+            idsv, hbv, tsv = tabs
+            tgt = _slot_of(seed, next_ep, idsv, k)           # (Nl, K)
+            key = jnp.where(idsv >= 0,
+                            _pack_key(seed, t, rows_u[:, None], idsv, tsv),
+                            jnp.uint32(0))
+            p = jnp.where(idsv >= 0, _pack_th(tsv, hbv), 0)
+
+            def block(args):
+                tgt_b, key_b, p_b = args
+                match = tgt_b[:, None, :] == kk[None, :, None]  # (B, K, K)
+                kf = (match * key_b[:, None, :]).max(2)
+                sel = match & (key_b[:, None, :] == kf[:, :, None]) \
+                    & (kf > 0)[:, :, None]
+                pf = jnp.where(sel, p_b[:, None, :], 0).max(2)
+                return kf, pf
+
+            nb = max(1, nl // REMAP_BLOCK)
+            if nb == 1:
+                kf, pf = block((tgt, key, p))
+            else:
+                shp = lambda x: x.reshape((nb, nl // nb, k))
+                kf, pf = jax.lax.map(block, (shp(tgt), shp(key), shp(p)))
+                kf = kf.reshape(nl, k)
+                pf = pf.reshape(nl, k)
+            return (jnp.where(kf > 0, (kf & ID_MASK).astype(jnp.int32) - 1,
+                              -1),
+                    jnp.where(kf > 0, (pf & 0xFFF) - 1, 0),
+                    jnp.where(kf > 0, (pf >> 12) - 1, 0))
+
+        ids2, hb2, ts2 = jax.lax.cond(
+            next_ep != slot_ep, reslot, lambda tabs: tabs, (ids2, hb2, ts2))
 
         # ---- dissemination: set the in-flight flags ----------------
         fis = jnp.arange(f, dtype=jnp.uint32)
@@ -684,21 +770,21 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
         live_member = in_group & ~failed & ~intro_onehot
         if with_coverage:
             covered = comm.psum(
-                jnp.zeros(n, jnp.int32).at[jnp.clip(ids2, 0).reshape(-1)]
-                .max((ids2 >= 0).reshape(-1).astype(jnp.int32))) > 0
+                jnp.zeros(n, jnp.int32).at[jnp.clip(ids_pre, 0).reshape(-1)]
+                .max((ids_pre >= 0).reshape(-1).astype(jnp.int32))) > 0
             live_uncovered = (live_member & ~covered).sum().astype(jnp.int32)
         else:
             live_uncovered = jnp.int32(-1)
 
         metrics = OverlayMetrics(
             in_group=in_group.sum().astype(jnp.int32),
-            view_slots=comm.psum((ids2 >= 0).sum().astype(jnp.int32)),
+            view_slots=comm.psum((ids_pre >= 0).sum().astype(jnp.int32)),
             adds=comm.psum(
                 ((ids1 != ids0) & (ids1 >= 0)).sum().astype(jnp.int32)),
             removals=removals,
             false_removals=false_removals,
             victim_slots=comm.psum(
-                ((ids2 >= 0) & subj_failed & ~stale).sum().astype(jnp.int32)),
+                ((ids_pre >= 0) & subj_failed & ~stale).sum().astype(jnp.int32)),
             live_uncovered=live_uncovered,
             sent=sent,
             recv=recv_cnt,
@@ -726,7 +812,7 @@ def make_overlay_run(cfg: SimConfig, length: int | None = None,
     shorter scan resumes mid-run bit-identically."""
     length = cfg.total_ticks if length is None else length
     if use_pallas is None:
-        use_pallas = False
+        use_pallas = jax.default_backend() == "tpu"
     key = (cfg.n, cfg.t_remove, length, resolved_dims(cfg), use_pallas)
     if key in _OVERLAY_RUN_CACHE:
         return _OVERLAY_RUN_CACHE[key]

@@ -1,43 +1,46 @@
-"""Fused Pallas TPU kernel: overlay XOR exchange + hash-slot merge.
+"""Fused Pallas TPU kernel: overlay XOR exchange + lane-aligned merge.
 
 The overlay tick's hot phase (models/overlay.py) is, per exchange round
 ``f``: permute the whole payload matrix by ``x[i ^ m_f]`` and fold the
-permuted candidate entries into the per-receiver hash-slotted view
-tables.  The XLA formulation pays for both halves:
+incoming view into the receiver's table.  The XLA formulation pays two
+HIGHEST-precision f32 permutation matmuls of O(sqrt(N)) contraction
+depth per round — O(N^1.5 · K) FLOPs that dominate the tick at the
+1M-peer BASELINE config.  This kernel makes the permutation nearly
+free and keeps every round VMEM-resident:
 
-* the XOR permutation is two HIGHEST-precision f32 permutation matmuls
-  of O(sqrt(N)) contraction depth — O(N^1.5 * C) FLOPs that dominate
-  the tick at the 1M-peer BASELINE config;
-* the merge materializes (N, K, L+1) broadcast intermediates in HBM,
-  several GB of transient traffic per tick at 65k.
-
-This kernel does both in one launch with the permutation *free* and
-the merge VMEM-resident:
-
-* the shard-free high bits of ``i ^ m`` are folded into the grid's
-  **block index map** (block ``i`` DMAs source block ``i ^ (m >> lgB)``
-  — the mask is a scalar-prefetch argument, so the DMA address is
-  known before the body runs);
-* the low bits are a **butterfly network in VMEM**: for each set bit
-  ``j`` of ``m % B``, rows swap with their ``r ^ 2^j`` partner — a
-  static rotate + select per bit, exact integer moves (the f32
-  matmul's bf16-truncation hazard is gone by construction);
-* the hash-slot merge is a serial pass over the L+1 candidate columns,
-  each a lexicographic (key, payload) max into the (B, K) accumulators
-  held in the output refs, which stay VMEM-resident across the F grid
-  steps (the output block index ignores the round axis).
+* grid = row blocks only; each step DMAs all F source blocks (the same
+  payload array bound F times, each with its own scalar-prefetched
+  **block index map** ``i ^ (m_f >> lgB)`` routing the mask's high
+  bits) and merges all F rounds into the accumulators in registers;
+* the mask's low bits are a **butterfly network in VMEM**: for each
+  set bit ``j`` of ``m % B``, rows swap with their ``r ^ 2^j`` partner
+  — a static rotate + select, predicated with ``pl.when`` so unset
+  bits cost nothing, exact integer moves (no bf16-truncation hazard);
+* entries travel packed — id word + ``_pack_th``-packed (ts, hb) word,
+  2K+1+F lanes per row — so the butterfly moves half the data of a
+  separate-planes layout, and the packed word IS the merge tiebreak
+  payload;
+* because tables are slotted by the global epoch map (models/overlay.py
+  design), the merge itself is a **lane-aligned lexicographic
+  (key, payload) max** on (B, K) — no slot-match product — plus a
+  one-hot merge of the partner's self-entry.
 
 Per tick the kernel reads the payload F times and the accumulators
-once — ~250 MB of HBM traffic at N=65536 versus the multi-GB XLA
-path, and no matmuls at all.
+once; there are no matmuls at all.
 
-Semantics are bit-identical to the XLA merge chain in
-models/overlay.py (same `_pack_key`/`_pack_th` contract, same
-candidate validity; lexicographic max is order-free, so fusing the
-rounds cannot change the winner).  Differentially tested in
+Semantics are bit-identical to the XLA phases in models/overlay.py
+(same ``_pack_key``/``_pack_th``/``_slot_of`` contract, same candidate
+validity; lexicographic max is order-free, so fusing the rounds cannot
+change the winner).  Differentially tested in
 tests/test_overlay_pallas.py; the receiver-side ``proc`` gate and the
 JOINREQ/JOINREP merges stay outside (models/overlay.py applies them —
 the merge is commutative, so ordering is free).
+
+Mosaic workarounds (observed on v5e): ``_pack_key`` must use the
+masked single-shift tie form — the ``(h >> 24) << 21`` shift pair
+miscompiles in large kernel contexts (small tie values land as 0); and
+``jnp.maximum`` on uint32 vectors does not legalize (``arith.maxui``),
+so the lexicographic merge sticks to compare+select.
 """
 
 from __future__ import annotations
@@ -59,96 +62,99 @@ def _roll_rows(x, shift: int):
     return jnp.concatenate([x[-s:], x[:-s]], axis=0)
 
 
-def _kernel(b: int, c: int, k: int, l: int, f_rounds: int, t_remove: int,
+def _kernel(b: int, c: int, k: int, f_rounds: int, t_remove: int,
             # scalar prefetch: [t, seed, m_0 .. m_{F-1}]
             sp_ref,
-            # inputs
-            payload_ref,                  # (B, C) block, pre-XOR'd high bits
-            curkey_ref, curp_ref,         # (B, K) accumulator init
-            # outputs (accumulated across the round axis)
-            kmax_ref, pacc_ref, recv_ref):
-    from ...models.overlay import _pack_key, _pack_th
-    from ...utils.hash32 import mix32
+            # inputs: the payload bound once per round + accumulator init
+            *refs):
+    from ...models.overlay import (SLOT_EPOCH, _pack_key, _pack_key_direct,
+                                   _pack_th, _slot_of)
 
-    fi = pl.program_id(1)
+    prefs = refs[:f_rounds]
+    curkey_ref, curp_ref, kmax_ref, pacc_ref, w_ref = refs[f_rounds:]
+
     i_blk = pl.program_id(0)
-
-    @pl.when(fi == 0)
-    def _init():
-        kmax_ref[:] = curkey_ref[:]
-        pacc_ref[:] = curp_ref[:]
-        recv_ref[:] = jnp.zeros_like(recv_ref)
-
     t = sp_ref[0]
     seed = sp_ref[1].astype(jnp.uint32)
-    m = sp_ref[2 + fi]
 
-    # ---- butterfly: finish the XOR permutation's low bits ----------
-    w = payload_ref[:]
     rbits = jax.lax.broadcasted_iota(jnp.int32, (b, 1), 0)
-    lgb = b.bit_length() - 1
-    for j in range(lgb):
-        s = 1 << j
-        swapped = jnp.where(((rbits >> j) & 1) == 0,
-                            _roll_rows(w, -s), _roll_rows(w, s))
-        w = jnp.where(((m >> j) & 1) == 1, swapped, w)
-
-    # ---- candidate merge: lexicographic (key, packed ts/hb) max ----
     rows = i_blk * b + rbits                       # (B, 1) global rows
     rows_u = rows.astype(jnp.uint32)
-    partner = rows ^ m
-    # this round's send flag: fi is traced, so select the column with
-    # an iota compare instead of a dynamic lane slice
-    flags_all = w[:, 3 * l + 1:3 * l + 1 + f_rounds]            # (B, F)
-    fsel = jax.lax.broadcasted_iota(jnp.int32, (b, f_rounds), 1) == fi
-    flag = jnp.where(fsel, flags_all, 0).max(axis=1, keepdims=True) > 0
     kk = jax.lax.broadcasted_iota(jnp.int32, (b, k), 1)
+    lgb = b.bit_length() - 1
+    slot_ep = (t // SLOT_EPOCH).astype(jnp.uint32)
 
-    kmax = kmax_ref[:]
-    pacc = pacc_ref[:]
-    for cand in range(l + 1):
-        if cand < l:
-            c_id = w[:, cand:cand + 1]
-            c_hb = w[:, l + cand:l + cand + 1]
-            c_ts = w[:, 2 * l + cand:2 * l + cand + 1]
-            fresh = t - c_ts < t_remove
-        else:                              # the partner's self-entry
-            c_id = partner
-            c_hb = w[:, 3 * l:3 * l + 1]
-            c_ts = jnp.full_like(c_id, 0) + (t - 1)
-            # its age is exactly 1, so freshness is static in t_remove
-            fresh = t_remove > 1
-        valid = flag & (c_id >= 0) & fresh & (c_id != rows)
-        c_idu = c_id.astype(jnp.uint32)
-        slot = (mix32(seed, rows_u, c_idu) % k).astype(jnp.int32)
-        keyc = jnp.where(valid, _pack_key(seed, t, rows_u, c_id, c_ts),
-                         jnp.uint32(0))
-        pc = jnp.where(valid, _pack_th(c_ts, c_hb), 0)
-        match = slot == kk                           # (B, K)
-        ck = jnp.where(match, keyc, jnp.uint32(0))
-        cp = jnp.where(match, pc, 0)
-        better = (ck > kmax) | ((ck == kmax) & (cp > pacc))
-        kmax = jnp.where(better, ck, kmax)
-        pacc = jnp.where(better, cp, pacc)
+    kmax = curkey_ref[:]
+    pacc = curp_ref[:]
+    recv = jnp.zeros((b, 1), jnp.int32)
+    for fi in range(f_rounds):
+        m = sp_ref[2 + fi]
+        # ---- butterfly: the XOR permutation's low bits, predicated
+        # per mask bit (unset bits cost nothing) ---------------------
+        w_ref[:] = prefs[fi][:]
+        for j in range(lgb):
+            s = 1 << j
+
+            @pl.when(((m >> j) & 1) == 1)
+            def _swap(s=s, j=j):
+                cur = w_ref[:]
+                w_ref[:] = jnp.where(((rbits >> j) & 1) == 0,
+                                     _roll_rows(cur, -s), _roll_rows(cur, s))
+        w = w_ref[:]
+
+        # ---- lane-aligned view merge ------------------------------
+        flag = w[:, 2 * k + 1 + fi:2 * k + 2 + fi] > 0   # (B, 1)
+        in_ids = w[:, :k]
+        in_p = w[:, k:2 * k]
+        in_ts = (in_p >> 12) - 1
+        valid = flag & (in_ids >= 0) & (t - in_ts < t_remove) \
+            & (in_ids != rows)
+        key = jnp.where(valid, _pack_key(seed, t, rows_u, in_ids, in_ts),
+                        jnp.uint32(0))
+        p = jnp.where(valid, in_p, 0)
+        better = (key > kmax) | ((key == kmax) & (p > pacc))
+        kmax = jnp.where(better, key, kmax)
+        pacc = jnp.where(better, p, pacc)
+
+        # ---- the partner's self-entry (one-hot; age exactly 1) ----
+        if t_remove > 1:
+            partner = rows ^ m
+            psl = _slot_of(seed, slot_ep, partner, k)           # (B, 1)
+            e_ts = jnp.zeros_like(partner) + (t - 1)
+            pkey = jnp.where(flag, _pack_key_direct(t, partner, e_ts),
+                             jnp.uint32(0))
+            pp = jnp.where(flag, _pack_th(e_ts, w[:, 2 * k:2 * k + 1]), 0)
+            match = psl == kk
+            ck = jnp.where(match, pkey, jnp.uint32(0))
+            cp = jnp.where(match, pp, 0)
+            better = (ck > kmax) | ((ck == kmax) & (cp > pacc))
+            kmax = jnp.where(better, ck, kmax)
+            pacc = jnp.where(better, cp, pacc)
+
+        recv = recv + flag.astype(jnp.int32)
+
     kmax_ref[:] = kmax
-    pacc_ref[:] = pacc
-
-    lane0 = jax.lax.broadcasted_iota(jnp.int32, (b, 128), 1) == 0
-    recv_ref[:] = recv_ref[:] + jnp.where(lane0, flag.astype(jnp.int32), 0)
+    # the pacc output is (B, 2K) — lanes [0, K) carry the payload
+    # accumulator and lane K the per-row recv count.  A (N, K) i32
+    # array is lane-padded to 128 in TPU tiling anyway, so the widened
+    # output costs no extra HBM and saves a separate (N, 128) buffer.
+    lane0 = jax.lax.broadcasted_iota(jnp.int32, (b, k), 1) == 0
+    pacc_ref[:] = jnp.concatenate([pacc, jnp.where(lane0, recv, 0)], axis=1)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("k", "l", "t_remove", "block_rows",
+                   static_argnames=("k", "t_remove", "block_rows",
                                     "interpret"))
 def fused_exchange_merge(payload, cur_key, cur_p, masks, t, seed, *,
-                         k: int, l: int, t_remove: int,
-                         block_rows: int = 256,
+                         k: int, t_remove: int,
+                         block_rows: int = 512,
                          interpret: bool | None = None):
     """All F exchange rounds' permute+merge in one Pallas launch.
 
     Args:
-      payload: i32[N, 3L+1+F] — per sender row: L-window ids, hbs, tss,
-        own_hb, then the F per-round send flags (0/1).
+      payload: i32[N, 2K+1+F] — per sender row: the K-slot view's ids,
+        the packed (ts, hb) words (``_pack_th``), own_hb, then the F
+        per-round send flags (0/1).
       cur_key/cur_p: u32/i32[N, K] — accumulators' initial value (the
         receiver's current table keys, models/overlay.py).
       masks: i32[F] — this tick's XOR masks ``m_f`` (all in [1, N)).
@@ -163,7 +169,7 @@ def fused_exchange_merge(payload, cur_key, cur_p, masks, t, seed, *,
         interpret = jax.default_backend() != "tpu"
     n, c = payload.shape
     f_rounds = int(masks.shape[0])
-    assert c == 3 * l + 1 + f_rounds, (c, l, f_rounds)
+    assert c == 2 * k + 1 + f_rounds, (c, k, f_rounds)
     b = min(block_rows, n)
     assert n % b == 0 and b & (b - 1) == 0 and b >= 8, (n, b)
     nb = n // b
@@ -174,31 +180,34 @@ def fused_exchange_merge(payload, cur_key, cur_p, masks, t, seed, *,
         seed.astype(i32).reshape(1),
         masks.astype(i32).reshape(f_rounds)])
 
-    row_block = lambda i, fi, sp_ref: (i, 0)
+    row_block = lambda i, sp_ref: (i, 0)
+
+    def payload_spec(fi):
+        return pl.BlockSpec(
+            (b, c),
+            lambda i, sp_ref, fi=fi: (i ^ (sp_ref[2 + fi] // b), 0),
+            memory_space=pltpu.VMEM)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(nb, f_rounds),
-        in_specs=[
-            pl.BlockSpec((b, c),
-                         lambda i, fi, sp_ref: (i ^ (sp_ref[2 + fi] // b), 0),
-                         memory_space=pltpu.VMEM),
+        grid=(nb,),
+        in_specs=[payload_spec(fi) for fi in range(f_rounds)] + [
             pl.BlockSpec((b, k), row_block, memory_space=pltpu.VMEM),
             pl.BlockSpec((b, k), row_block, memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((b, k), row_block, memory_space=pltpu.VMEM),
-            pl.BlockSpec((b, k), row_block, memory_space=pltpu.VMEM),
-            pl.BlockSpec((b, 128), row_block, memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, 2 * k), row_block, memory_space=pltpu.VMEM),
         ],
+        scratch_shapes=[pltpu.VMEM((b, c), i32)],
     )
-    kmax, pacc, recv = pl.pallas_call(
-        functools.partial(_kernel, b, c, k, l, f_rounds, t_remove),
+    kmax, pacc_recv = pl.pallas_call(
+        functools.partial(_kernel, b, c, k, f_rounds, t_remove),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((n, k), jnp.uint32),
-            jax.ShapeDtypeStruct((n, k), i32),
-            jax.ShapeDtypeStruct((n, 128), i32),
+            jax.ShapeDtypeStruct((n, 2 * k), i32),
         ],
         interpret=interpret,
-    )(sp, payload, cur_key, cur_p)
-    return kmax, pacc, recv[:, 0]
+    )(sp, *([payload] * f_rounds), cur_key, cur_p)
+    return kmax, pacc_recv[:, :k], pacc_recv[:, k]

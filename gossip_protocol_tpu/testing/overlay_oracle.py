@@ -3,12 +3,12 @@ re-implementation of models/overlay.py's tick semantics, used only for
 differential testing at small N.
 
 Because the overlay derives *all* of its randomness and schedules from
-pure counter hashing (utils/hash32.py) — XOR exchange masks, slot
-permutations, rotated tiebreaks, drop decisions, churn membership —
-this oracle replays the exact device behavior with no replay harness,
-and the comparison is bit-exact on the full state trajectory
-(tests/test_overlay.py).  It is deliberately slow and explicit; its
-only job is to be obviously correct.
+pure counter hashing (utils/hash32.py) — XOR exchange masks, the
+epoch-rotated global slot map, rotated tiebreaks, drop decisions,
+churn membership — this oracle replays the exact device behavior with
+no replay harness, and the comparison is bit-exact on the full state
+trajectory (tests/test_overlay.py).  It is deliberately slow and
+explicit; its only job is to be obviously correct.
 """
 
 from __future__ import annotations
@@ -16,10 +16,11 @@ from __future__ import annotations
 import numpy as np
 
 from ..config import INTRODUCER, SimConfig
-from ..models.overlay import (BAND, EPOCH, ID_BITS, _SALT_CHURN,
+from ..models.overlay import (BAND, EPOCH, ID_BITS, SLOT_EPOCH, _SALT_CHURN,
                               _SALT_CHURN_TICK, _SALT_GOSSIP_DROP,
                               _SALT_JOINREP_DROP, _SALT_JOINREQ_DROP,
-                              _SALT_MASK, _TIE_BITS, _pack_th, resolved_dims)
+                              _SALT_MASK, _SALT_SLOT, _TIE_BITS, _pack_th,
+                              resolved_dims)
 from ..state import NEVER
 from ..utils.hash32 import mix32, threshold32
 
@@ -29,7 +30,7 @@ U = np.uint32
 class OverlayOracle:
     def __init__(self, cfg: SimConfig):
         self.cfg = cfg
-        self.k, self.l, self.f = resolved_dims(cfg)
+        self.k, self.f = resolved_dims(cfg)
         n = cfg.n
         self.n = n
         self.seed = U(cfg.seed & 0xFFFFFFFF)
@@ -93,14 +94,24 @@ class OverlayOracle:
                 and self.cfg.drop_open_tick < t <= self.cfg.drop_close_tick)
 
     # --- protocol pieces --------------------------------------------
-    def slot(self, r, j):
-        return int(mix32(self.seed, U(r), U(np.uint32(j))) % self.k)
+    def slot(self, epoch, j):
+        """Global slot of subject ``j`` during slot epoch ``epoch``."""
+        return int(mix32(self.seed, U(epoch), U(np.uint32(j)),
+                         U(_SALT_SLOT)) % self.k)
 
     def key(self, t, r, j, ts):
         age = min(max(t - ts, 0), 8 * BAND - 1)
         band = (7 - age // BAND) << (ID_BITS + _TIE_BITS)
         tie = (int(mix32(self.seed, U(t // EPOCH), U(r), U(np.uint32(j))))
                >> (32 - _TIE_BITS)) << ID_BITS
+        return band | tie | (j + 1)
+
+    def key_direct(self, t, j, ts):
+        """Saturated-tie key of a direct self-entry / JOINREQ
+        (models/overlay.py _pack_key_direct)."""
+        age = min(max(t - ts, 0), 8 * BAND - 1)
+        band = (7 - age // BAND) << (ID_BITS + _TIE_BITS)
+        tie = ((1 << _TIE_BITS) - 1) << ID_BITS
         return band | tie | (j + 1)
 
     def mask(self, t, fi):
@@ -110,8 +121,9 @@ class OverlayOracle:
     # --- one tick ---------------------------------------------------
     def step(self):
         t = self.t
-        n, k, l, f = self.n, self.k, self.l, self.f
+        n, k, f = self.n, self.k, self.f
         T = self.cfg.t_remove
+        epoch = t // SLOT_EPOCH          # layout of all tables this tick
         proc = np.array([t > self.start_of(i) and not self.failed(i, t)
                          for i in range(n)])
         rejoining = np.array([self.rejoin_of(i) == t for i in range(n)])
@@ -124,9 +136,9 @@ class OverlayOracle:
             self.in_group[i] = False
             self.own_hb[i] = 0
 
-        win = [((t - 1) * l + q) % k for q in range(l)]
-
-        # candidates per receiver from the XOR exchange partners
+        # candidates per receiver: (slot, subject, hb, ts) — incoming
+        # tables are slotted by the same global map, so a table entry's
+        # slot is its own position; the partner self-entry hashes in
         cands = [[] for _ in range(n)]
         recv = 0
         for fi in range(f):
@@ -136,22 +148,24 @@ class OverlayOracle:
                 if not (self.send_flags[p, fi] and proc[r]):
                     continue
                 recv += 1
-                for q in win:
+                for q in range(k):
                     if self.ids[p, q] >= 0:
-                        cands[r].append((int(self.ids[p, q]),
+                        cands[r].append((q, int(self.ids[p, q]),
                                          int(self.hb[p, q]),
-                                         int(self.ts[p, q])))
-                cands[r].append((p, int(self.own_hb[p]), t - 1))
+                                         int(self.ts[p, q]), False))
+                cands[r].append((self.slot(epoch, p), p,
+                                 int(self.own_hb[p]), t - 1, True))
 
         # JOINREP consumption
         jrep = self.joinrep & proc
         for r in np.flatnonzero(jrep):
-            for q in win:
+            for q in range(k):
                 if self.ids[INTRODUCER, q] >= 0:
-                    cands[r].append((int(self.ids[INTRODUCER, q]),
+                    cands[r].append((q, int(self.ids[INTRODUCER, q]),
                                      int(self.hb[INTRODUCER, q]),
-                                     int(self.ts[INTRODUCER, q])))
-            cands[r].append((INTRODUCER, int(self.own_hb[INTRODUCER]), t - 1))
+                                     int(self.ts[INTRODUCER, q]), False))
+            cands[r].append((self.slot(epoch, INTRODUCER), INTRODUCER,
+                             int(self.own_hb[INTRODUCER]), t - 1, True))
             recv += 1
         in_group = self.in_group | jrep
 
@@ -160,7 +174,8 @@ class OverlayOracle:
         recv += int(jreq.sum())
         for j in np.flatnonzero(jreq):
             if j != INTRODUCER:
-                cands[INTRODUCER].append((int(j), 1, t))
+                cands[INTRODUCER].append((self.slot(epoch, int(j)),
+                                          int(j), 1, t, True))
 
         # merge: per-slot max of the packed priority key; among equal
         # keys the winner payload is the max packed _pack_th(ts, hb)
@@ -173,11 +188,11 @@ class OverlayOracle:
         new_ts = self.ts.copy()
         for r in range(n):
             best = {}
-            for (j, hb, ts) in cands[r]:
+            for (sl, j, hb, ts, direct) in cands[r]:
                 if not (t - ts < T) or j == r or j < 0:
                     continue
-                sl = self.slot(r, j)
-                kkey = self.key(t, r, j, ts)
+                kkey = (self.key_direct(t, j, ts) if direct
+                        else self.key(t, r, j, ts))
                 p = pack_th(ts, hb)
                 cur = best.get(sl)
                 if cur is None or kkey > cur[0]:
@@ -224,6 +239,34 @@ class OverlayOracle:
                     new_ids[r, sl] = -1
                     new_hb[r, sl] = 0
                     new_ts[r, sl] = 0
+
+        # slot-map re-roll at the SLOT_EPOCH boundary (every row —
+        # layout is global, not protocol activity); contention resolved
+        # by the same lexicographic (key, payload) rule
+        if (t + 1) // SLOT_EPOCH != epoch:
+            nxt = (t + 1) // SLOT_EPOCH
+            rm_ids = np.full_like(new_ids, -1)
+            rm_hb = np.zeros_like(new_hb)
+            rm_ts = np.zeros_like(new_ts)
+            for r in range(n):
+                best = {}
+                for q in range(k):
+                    j = int(new_ids[r, q])
+                    if j < 0:
+                        continue
+                    sl = self.slot(nxt, j)
+                    kkey = self.key(t, r, j, int(new_ts[r, q]))
+                    p = pack_th(int(new_ts[r, q]), int(new_hb[r, q]))
+                    cur = best.get(sl)
+                    if cur is None or kkey > cur[0]:
+                        best[sl] = [kkey, p]
+                    elif kkey == cur[0]:
+                        cur[1] = max(cur[1], p)
+                for sl, (kkey, p) in best.items():
+                    rm_ids[r, sl] = (kkey & ((1 << ID_BITS) - 1)) - 1
+                    rm_ts[r, sl] = (p >> 12) - 1
+                    rm_hb[r, sl] = (p & 0xFFF) - 1
+            new_ids, new_hb, new_ts = rm_ids, rm_hb, rm_ts
 
         # dissemination: in-flight flags for the next tick
         new_flags = np.zeros((n, f), bool)

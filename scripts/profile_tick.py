@@ -22,18 +22,26 @@ from gossip_protocol_tpu.models.overlay import (init_overlay_state,
                                                 resolved_dims)
 
 
-def scan_time(tick, state, sched, reps=3, length=50):
+def scan_time(tick, state, sched, reps=3, length=200):
+    import numpy as np
+
     @jax.jit
     def scanned(s):
         def step(c, _):
             return tick(c, sched)[0], None
         return jax.lax.scan(step, s, None, length=length)[0]
 
-    jax.block_until_ready(scanned(state))
+    # distinct inputs per call and a readback inside the timed region:
+    # the relay memoizes identical (executable, args) pairs and
+    # block_until_ready alone can return on dispatch ack (see
+    # .claude/skills/verify/SKILL.md)
+    variants = [state.replace(own_hb=state.own_hb + i)
+                for i in range(reps + 1)]
+    np.asarray(jax.block_until_ready(scanned(variants[0])).tick)
     best = float("inf")
-    for _ in range(reps):
+    for i in range(reps):
         t0 = time.perf_counter()
-        jax.block_until_ready(scanned(state))
+        np.asarray(jax.block_until_ready(scanned(variants[i + 1])).tick)
         best = min(best, time.perf_counter() - t0)
     return best / length
 
@@ -44,10 +52,11 @@ def main():
     cfg = SimConfig(max_nnb=n, model="overlay", single_failure=False,
                     drop_msg=False, seed=0, total_ticks=300,
                     churn_rate=0.2, rejoin_after=40, step_rate=64.0 / n)
-    print(f"N={n} (K, L, F)={resolved_dims(cfg)}")
+    print(f"N={n} (K, F)={resolved_dims(cfg)}")
     sched = make_overlay_schedule(cfg)
     state = init_overlay_state(cfg)
-    length = 50 if n <= (1 << 17) else 10
+    # long scans amortize the ~100ms relay dispatch cost per call
+    length = 200 if n <= (1 << 17) else 25
     for label, up in (("xla", False), ("pallas", True)):
         dt = scan_time(make_overlay_tick(cfg, use_pallas=up), state, sched,
                        length=length)
