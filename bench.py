@@ -58,26 +58,36 @@ def _backend_or_cpu(timeout_s: float = 180.0) -> str:
     return backend if backend not in ("error",) else "cpu"
 
 
-def bench_overlay(n: int, ticks: int, drop: bool = False):
-    """BASELINE configs: 20% churn (the 65k shape) or 10% message drop
-    (the 4096 shape)."""
+def bench_overlay(n: int, ticks: int, mode: str = "churn",
+                  topology: str = "uniform"):
+    """BASELINE configs: 20% churn (the 65k shape), 10% message drop
+    (the 4096 shape), or a scripted failure under the power-law
+    topology (the 1M scale-free shape)."""
     import numpy as np
 
     from gossip_protocol_tpu.config import SimConfig
     from gossip_protocol_tpu.models.overlay import OverlaySimulation
 
-    if drop:
+    if mode == "drop":
         # like the reference's msgdrop scenario, the join ramp finishes
         # before the drop window opens (tick 50), so a dropped JOINREQ
         # can never orphan a peer
         cfg = SimConfig(max_nnb=n, model="overlay", single_failure=True,
                         drop_msg=True, msg_drop_prob=0.1, seed=0,
                         total_ticks=ticks, fail_tick=ticks // 2,
-                        step_rate=40.0 / n)
+                        step_rate=40.0 / n, topology=topology)
+    elif mode == "fail":
+        cfg = SimConfig(max_nnb=n, model="overlay", single_failure=True,
+                        drop_msg=False, seed=0, total_ticks=ticks,
+                        fail_tick=ticks // 2, step_rate=40.0 / n,
+                        topology=topology)
+    elif mode != "churn":
+        raise ValueError(f"unknown bench_overlay mode {mode!r}")
     else:
         cfg = SimConfig(max_nnb=n, model="overlay", single_failure=False,
                         drop_msg=False, seed=0, total_ticks=ticks,
-                        churn_rate=0.2, rejoin_after=40, step_rate=64.0 / n)
+                        churn_rate=0.2, rejoin_after=40, step_rate=64.0 / n,
+                        topology=topology)
     OverlaySimulation(cfg).run()          # compile + warm (seed 0)
     best = None
     for rep in range(2):
@@ -139,8 +149,25 @@ def main():
 
     overlay = bench_overlay(n_overlay, t_overlay)
     n_drop = min(4096, n_overlay)              # BASELINE "4096, 10% drop"
-    overlay_drop = bench_overlay(n_drop, max(t_overlay, 200), drop=True)
+    overlay_drop = bench_overlay(n_drop, max(t_overlay, 200), mode="drop")
     dense = bench_dense(n_dense, t_dense)
+
+    secondary = {
+        f"node_ticks_per_s_n{n_drop}_overlay_drop10": round(overlay_drop, 1),
+        "overlay_drop10_vs_baseline": round(
+            overlay_drop / REFERENCE_NODE_TICKS_PER_S, 3),
+        f"node_ticks_per_s_n{n_dense}_fullview": round(dense, 1),
+        "fullview_vs_baseline": round(dense / REFERENCE_NODE_TICKS_PER_S, 3),
+    }
+    if backend == "tpu" and not smoke:
+        # BASELINE's 1M north-star shape: power-law overlay, validated
+        # (join completeness, victim purge, live coverage)
+        pl_1m = bench_overlay(1 << 20, 260, mode="fail",
+                              topology="powerlaw")
+        secondary["node_ticks_per_s_n1048576_overlay_powerlaw"] = \
+            round(pl_1m, 1)
+        secondary["overlay_powerlaw_1m_vs_baseline"] = round(
+            pl_1m / REFERENCE_NODE_TICKS_PER_S, 3)
 
     print(json.dumps({
         "metric": f"node_ticks_per_s_n{n_overlay}_overlay_churn20",
@@ -148,13 +175,7 @@ def main():
         "unit": "node-ticks/s",
         "vs_baseline": round(overlay / REFERENCE_NODE_TICKS_PER_S, 3),
         "backend": backend,
-        "secondary": {
-            f"node_ticks_per_s_n{n_drop}_overlay_drop10": round(overlay_drop, 1),
-            "overlay_drop10_vs_baseline": round(
-                overlay_drop / REFERENCE_NODE_TICKS_PER_S, 3),
-            f"node_ticks_per_s_n{n_dense}_fullview": round(dense, 1),
-            "fullview_vs_baseline": round(dense / REFERENCE_NODE_TICKS_PER_S, 3),
-        },
+        "secondary": secondary,
     }))
 
 

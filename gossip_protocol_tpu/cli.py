@@ -47,6 +47,10 @@ def main(argv=None) -> int:
                     help="protocol family: full_view (reference-faithful, "
                          "dbg.log output) or overlay (bounded partial-view "
                          "for large N; prints one summary-metrics JSON line)")
+    ap.add_argument("--topology", default=None,
+                    choices=["uniform", "powerlaw"],
+                    help="overlay exchange-degree family (uniform fanout "
+                         "or scale-free Pareto out-degrees)")
     args = ap.parse_args(argv)
 
     if args.platform:
@@ -65,6 +69,8 @@ def main(argv=None) -> int:
         overrides["total_ticks"] = args.ticks
     if args.model is not None:
         overrides["model"] = args.model
+    if args.topology is not None:
+        overrides["topology"] = args.topology
     try:
         cfg = SimConfig.from_conf(args.conf, **overrides)
     except (OSError, ValueError) as e:

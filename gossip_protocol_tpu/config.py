@@ -74,6 +74,15 @@ class SimConfig:
     #: Overlay payload sample L: view slots carried per message
     #: (rotating window; full view every K/L ticks).  0 = auto (K/2).
     overlay_sample: int = 0
+    #: Exchange-graph degree family (overlay only).  "uniform": every
+    #: node gossips on all F rounds each tick (Erdős–Rényi-flavored —
+    #: the BASELINE 65k shape).  "powerlaw": per-node out-degrees
+    #: follow a bounded Pareto tail (P[deg >= k] ~ k^-(alpha-1), the
+    #: BASELINE 1M scale-free shape): a few hubs gossip on many rounds,
+    #: most nodes on few.  Degrees are a static seeded node property.
+    topology: str = "uniform"
+    #: Pareto tail exponent for topology="powerlaw".
+    powerlaw_alpha: float = 2.5
     #: Churn rate per tick (overlay extension; 0 disables).
     churn_rate: float = 0.0
     #: Churn/rejoin extension (SURVEY.md §5 — the reference never

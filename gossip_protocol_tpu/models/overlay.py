@@ -117,6 +117,7 @@ _SALT_JOINREP_DROP = 4
 _SALT_CHURN = 5
 _SALT_CHURN_TICK = 6
 _SALT_SLOT = 7
+_SALT_DEGREE = 8
 
 
 @struct.dataclass
@@ -161,6 +162,8 @@ class OverlaySchedule:
     drop_open: jax.Array    # i32 — droppable sends: open < t <= close
     drop_close: jax.Array   # i32
     drop_thr: jax.Array     # u32 — per-message Bernoulli threshold
+    deg_thr: jax.Array      # u32[F-1] — power-law out-degree CDF
+                            #   thresholds (degree_thresholds)
 
     def start_of(self, i):
         return (i * self.step_num) // self.step_den
@@ -238,6 +241,7 @@ def make_overlay_schedule(cfg: SimConfig) -> OverlaySchedule:
         drop_open=jnp.int32(cfg.drop_open_tick),
         drop_close=jnp.int32(cfg.drop_close_tick),
         drop_thr=jnp.uint32(threshold32(cfg.msg_drop_prob)),
+        deg_thr=jnp.asarray(degree_thresholds(cfg, resolved_dims(cfg)[1])),
     )
 
 
@@ -283,8 +287,37 @@ def resolved_dims(cfg: SimConfig):
     b = int(math.ceil(math.log2(max(n, 4))))
     k = cfg.overlay_view if cfg.overlay_view > 0 \
         else min(64, max(16, 8 * ((b + 1) // 2)))
-    f = cfg.fanout if cfg.fanout > 0 else 4
+    if cfg.fanout > 0:
+        f = cfg.fanout
+    elif cfg.topology == "powerlaw":
+        # F is the hub degree cap; the MEAN degree is sum k^-(a-1)/...,
+        # ~1.9 at alpha=2.5 — leaves gossip rarely, hubs every round
+        f = 8
+    else:
+        f = 4
     return k, f
+
+
+def degree_thresholds(cfg: SimConfig, f: int):
+    """uint32 CDF thresholds of the bounded Pareto out-degree draw.
+
+    ``deg(i) = 1 + sum_{k=2..F} [mix32(seed, i, SALT_DEGREE) < thr_k]``
+    with ``thr_k = round(2^32 * k^-(alpha-1))`` — so
+    ``P[deg >= k] = k^-(alpha-1)`` (clipped to [1, F]).  Computed once
+    on host in float64, compared in pure uint32 on device, replayed
+    bit-exactly by the numpy oracle.  For topology="uniform" every
+    threshold saturates and deg(i) = F for all i.
+    """
+    if cfg.topology == "uniform":
+        return np.full(max(f - 1, 1), 0xFFFFFFFF, np.uint32)
+    if cfg.topology != "powerlaw":
+        raise ValueError(f"unknown overlay topology {cfg.topology!r}")
+    a = float(cfg.powerlaw_alpha)
+    if a <= 1.0:
+        raise ValueError("powerlaw_alpha must be > 1")
+    thr = [min(0xFFFFFFFF, int(round(4294967296.0 * k ** (-(a - 1.0)))))
+           for k in range(2, f + 1)]
+    return np.asarray(thr if thr else [0], np.uint32)
 
 
 def _xor_factors(n: int):
@@ -439,6 +472,7 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     use_kernel = bool(use_pallas) and isinstance(comm, LocalOverlayComm)
+    powerlaw = cfg.topology == "powerlaw"
     n = cfg.n
     k, f = resolved_dims(cfg)
     t_remove = cfg.t_remove
@@ -564,7 +598,9 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
                             0)
         keymax = cur_key
         p_acc = p0
-        recv_cnt = jnp.zeros((), jnp.int32)
+        # zero derived from a shard-local value so the exchange scan's
+        # carry is shard-varying from the start (shard_map VMA typing)
+        recv_cnt = (proc_l.sum() * 0).astype(jnp.int32)
 
         def lex_merge(keymax, p_acc, key_c, p_c):
             better = (key_c > keymax) | ((key_c == keymax) & (p_c > p_acc))
@@ -610,11 +646,18 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
             p_acc = jnp.where(proc_l[:, None], pacc_k, p_acc)
             recv_cnt = (recv_row * proc_l.astype(jnp.int32)).sum()
         else:
-            for fi in range(f):
-                mask = exchange_mask(seed, t - 1, fi, n)
-                flag_col = state.send_flags[:, fi].astype(jnp.float32)[:, None]
+            # rounds are structurally identical, so scan over the mask
+            # axis instead of unrolling — XLA's CPU pipeline was
+            # observed to hang compiling >= 8 unrolled rounds, and the
+            # scan keeps compile size constant in F
+            masks = jnp.stack([exchange_mask(seed, t - 1, fi, n)
+                               for fi in range(f)])
+
+            def exchange_round(carry, mf):
+                keymax, p_acc, recv_cnt = carry
+                mask, flag_col = mf
                 q = xor_perm(
-                    jnp.concatenate([payload, flag_col], 1), mask)
+                    jnp.concatenate([payload, flag_col[:, None]], 1), mask)
                 partner = rows_g ^ mask
                 in_ids = q[:, :k].astype(jnp.int32)
                 in_p = q[:, k:2 * k].astype(jnp.int32)
@@ -631,6 +674,11 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
                     keymax, p_acc = entry_merge(
                         keymax, p_acc, partner,
                         jnp.broadcast_to(t - 1, (nl,)), own_p, ok)
+                return (keymax, p_acc, recv_cnt), None
+
+            (keymax, p_acc, recv_cnt), _ = jax.lax.scan(
+                exchange_round, (keymax, p_acc, recv_cnt),
+                (masks, state.send_flags.astype(jnp.float32).T))
         recv_cnt = comm.psum(recv_cnt)
 
         # ---- JOINREP consumption (introducer's payload broadcast) --
@@ -758,6 +806,16 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
         gdrop = mix32(seed, tu, rows_u[:, None], fis[None, :],
                       np.uint32(_SALT_GOSSIP_DROP)) < sched.drop_thr
         send_flags = ops_l[:, None] & ~(active & gdrop)
+        if powerlaw:
+            # scale-free out-degrees: node i gossips only on its first
+            # deg(i) rounds (a static seeded node property; hubs cover
+            # all F rounds, leaves one).  Statically compiled out for
+            # the uniform topology.
+            du = mix32(seed, rows_u, np.uint32(_SALT_DEGREE))
+            deg = 1 + (du[:, None] < sched.deg_thr[None, :]) \
+                .sum(1).astype(jnp.int32)
+            send_flags = send_flags \
+                & (fis.astype(jnp.int32)[None, :] < deg[:, None])
         sent = comm.psum(send_flags.sum().astype(jnp.int32)) \
             + joinreq_sent.sum().astype(jnp.int32) \
             + joinrep_sent.sum().astype(jnp.int32)
@@ -813,7 +871,8 @@ def make_overlay_run(cfg: SimConfig, length: int | None = None,
     length = cfg.total_ticks if length is None else length
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
-    key = (cfg.n, cfg.t_remove, length, resolved_dims(cfg), use_pallas)
+    key = (cfg.n, cfg.t_remove, length, resolved_dims(cfg), use_pallas,
+           cfg.topology)
     if key in _OVERLAY_RUN_CACHE:
         return _OVERLAY_RUN_CACHE[key]
     tick = make_overlay_tick(cfg, use_pallas=use_pallas)

@@ -17,9 +17,10 @@ import numpy as np
 
 from ..config import INTRODUCER, SimConfig
 from ..models.overlay import (BAND, EPOCH, ID_BITS, SLOT_EPOCH, _SALT_CHURN,
-                              _SALT_CHURN_TICK, _SALT_GOSSIP_DROP,
-                              _SALT_JOINREP_DROP, _SALT_JOINREQ_DROP,
-                              _SALT_MASK, _SALT_SLOT, _TIE_BITS, _pack_th,
+                              _SALT_CHURN_TICK, _SALT_DEGREE,
+                              _SALT_GOSSIP_DROP, _SALT_JOINREP_DROP,
+                              _SALT_JOINREQ_DROP, _SALT_MASK, _SALT_SLOT,
+                              _TIE_BITS, _pack_th, degree_thresholds,
                               resolved_dims)
 from ..state import NEVER
 from ..utils.hash32 import mix32, threshold32
@@ -36,6 +37,7 @@ class OverlayOracle:
         self.seed = U(cfg.seed & 0xFFFFFFFF)
         self.drop_thr = threshold32(cfg.msg_drop_prob)
         self.churn_thr = threshold32(cfg.churn_rate) if cfg.churn_rate > 0 else 0
+        self.deg_thr = degree_thresholds(cfg, self.f)
 
         from fractions import Fraction
         frac = Fraction(cfg.step_rate).limit_denominator(1 << 15)
@@ -272,7 +274,11 @@ class OverlayOracle:
         new_flags = np.zeros((n, f), bool)
         sent = int(joinreq_sent.sum()) + int(joinrep_sent.sum())
         for r in np.flatnonzero(ops):
-            for fi in range(f):
+            deg = f
+            if self.cfg.topology == "powerlaw":
+                du = int(mix32(self.seed, U(r), U(_SALT_DEGREE)))
+                deg = 1 + sum(1 for thr in self.deg_thr if du < int(thr))
+            for fi in range(deg):
                 gdrop = active and int(mix32(self.seed, U(t), U(r), U(fi),
                                              U(_SALT_GOSSIP_DROP))) < self.drop_thr
                 if not gdrop:

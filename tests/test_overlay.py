@@ -40,6 +40,9 @@ def _overlay_cfg(**kw):
                         rejoin_after=20, total_ticks=120, seed=5)),
     ("wide", dict(max_nnb=64, seed=3, overlay_view=16, overlay_sample=4,
                   fanout=4)),
+    ("powerlaw", dict(max_nnb=64, seed=6, topology="powerlaw",
+                      total_ticks=100, drop_msg=True, msg_drop_prob=0.1,
+                      drop_open_tick=20, drop_close_tick=80)),
 ])
 def test_overlay_oracle_parity(name, kw):
     """Bit-exact state trajectory vs the scalar oracle."""
@@ -131,6 +134,42 @@ def test_overlay_churn_recovers():
     # and their view entries were purged (evicted by fresh rivals or
     # staleness-removed — victim_slots reaching 0 covers both paths)
     assert int(np.asarray(m.victim_slots).max()) > 0
+
+
+def test_overlay_powerlaw_topology():
+    """Scale-free out-degrees (BASELINE's 1M shape): degrees follow the
+    bounded Pareto tail, and the global guarantees still hold — every
+    live member covered, victim purged within the (slower, low-mean-
+    degree) horizon."""
+    from gossip_protocol_tpu.models.overlay import (_SALT_DEGREE,
+                                                    degree_thresholds,
+                                                    resolved_dims)
+    from gossip_protocol_tpu.utils.hash32 import mix32
+
+    cfg = SimConfig(max_nnb=512, model="overlay", single_failure=True,
+                    drop_msg=False, seed=1, total_ticks=260, fail_tick=140,
+                    topology="powerlaw")
+    k, f = resolved_dims(cfg)
+    assert f == 8
+    # the seeded degree distribution matches the bounded Pareto tail
+    thr = degree_thresholds(cfg, f)
+    du = np.asarray([int(mix32(np.uint32(cfg.seed), np.uint32(i),
+                               np.uint32(_SALT_DEGREE)))
+                     for i in range(cfg.n)], np.int64)
+    deg = 1 + (du[:, None] < thr[None, :].astype(np.int64)).sum(1)
+    assert deg.min() == 1 and deg.max() == f
+    assert 1.4 < deg.mean() < 2.6          # ~1.9 expected at alpha=2.5
+    res = OverlaySimulation(cfg).run()
+    m = res.metrics
+    joined = np.flatnonzero(np.asarray(m.in_group) == cfg.n)
+    assert joined.size
+    # coverage: direct self-entries guarantee it even for degree-1 leaves
+    assert (np.asarray(m.live_uncovered)[joined[0] + 3:] == 0).all()
+    # victim purged (low supply -> allow extra sampling slack)
+    vs = np.asarray(m.victim_slots)
+    assert (vs[cfg.fail_tick + cfg.t_remove + 20:] == 0).all()
+    uncovered, victim_left = res.final_coverage()
+    assert uncovered == 0 and victim_left == 0
 
 
 def test_overlay_staleness_removal_fires():
