@@ -91,17 +91,15 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
     t_remove = cfg.t_remove
     churn = cfg.rejoin_after is not None
     assert n % comm.n_shards == 0, "peer count must divide the mesh axis"
-    # the fused kernel needs its exact tile divisibility (row tile 64,
-    # sender tile = block_size, both sublane-aligned — mirrors the
-    # asserts in fused_tick_update) and bounded VMEM: its column tiles
-    # span the full peer axis, and n=1024 already exceeds the 16 MB
-    # scoped-VMEM budget (measured).  Everything else falls back to
-    # the composable ops.
+    # the fused epilogue kernel needs its tile divisibility (row tile
+    # 64, sublane-aligned — mirrors the asserts in fused_tick_update)
+    # and bounded VMEM: its row tiles span the full peer axis, and
+    # n = 4096 exceeds the 16 MB scoped-VMEM budget with ~17 live
+    # (TR, N) planes.  Everything else falls back to the composable
+    # ops (which still use the MXU merge when use_pallas is on).
     _tr = min(64, n)
-    _tss = min(block_size, n)
     fused = (isinstance(comm, LocalComm) and comm.use_pallas
-             and n <= 512 and n % _tr == 0 and n % _tss == 0
-             and _tr % 8 == 0 and _tss % 8 == 0)
+             and n <= 2048 and n % _tr == 0 and _tr % 8 == 0)
 
     def tick(state: WorldState, sched: Schedule):
         t = state.tick
@@ -174,14 +172,21 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
         live_hold = ~proc & ~failed
 
         if fused:
-            # one Pallas pass: merge + membership update + detection +
-            # dissemination (ops/pallas/tickfused.py)
+            # merge maxima by MXU level decomposition (via the comm's
+            # merge dispatch, so fused and composable paths always run
+            # the same merge), then one Pallas pass for membership
+            # update + detection + dissemination
+            # (ops/pallas/tickfused.py)
             from ..ops.pallas.tickfused import fused_tick_update
+            m_all, m_fresh, t_fresh, _ = comm.merge_reduce(
+                recv_from, st_known, st_hb, st_ts, t,
+                t_remove=t_remove, block_size=block_size)
             known, hb, ts, gossip_next, gsent_row, added_m, removed_m = \
                 fused_tick_update(
-                    recv_from, st_known, st_hb, st_ts, state.gossip, gdrop,
+                    m_all, m_fresh, t_fresh, recv_from,
+                    st_known, st_hb, st_ts, state.gossip, gdrop,
                     ops, jrep, jreq, live_hold, t, t_remove=t_remove,
-                    tile_s=block_size, with_events=with_events)
+                    with_events=with_events)
             joinreq_next = joinreq_sent | (state.joinreq
                                            & ~proc[INTRODUCER]
                                            & ~failed[INTRODUCER])

@@ -1,5 +1,4 @@
-"""Fused Pallas TPU kernel: gossip merge + the whole per-cell tick
-update in one pass.
+"""Fused Pallas TPU kernel: the dense tick's post-merge epilogue.
 
 Through this TPU stack each XLA kernel launch inside the scan costs
 ~10µs regardless of size, and the dense tick's post-merge phase is a
@@ -7,22 +6,24 @@ chain of ~30 small (N, N) elementwise ops — more than half the tick's
 wall time at N=512.  This kernel computes, per (row, col) cell and in
 one VMEM-resident pass:
 
-  1. the three product-max merge reductions over the sender axis
-     (identical contract to ops/merge.py — the (max, and) semiring
-     replacement for MP1Node.cpp:236-256);
-  2. the merge-into-existing / piggyback-add / direct-sender /
+  1. the merge-into-existing / piggyback-add / direct-sender /
      JOINREQ / JOINREP membership updates (core/tick.py's
-     checkMessages phase);
-  3. staleness detection (nodeLoopOps, MP1Node.cpp:339-348);
-  4. dissemination + drop masking + the in-flight hold
+     checkMessages phase) from the three merge maxima;
+  2. staleness detection (nodeLoopOps, MP1Node.cpp:339-348);
+  3. dissemination + drop masking + the in-flight hold
      (EmulNet ENsend semantics), producing the next gossip matrix;
-  5. per-row sent counters and (in trace mode) the add/remove event
+  4. per-row sent counters and (in trace mode) the add/remove event
      masks.
 
-Grid is (R/TR, 1, S/TS): the sender axis is innermost and accumulates
-the merge maxima in VMEM scratch; the epilogue (2-5) runs once at the
-last sender step.  Column tiles span the full peer axis so the
-JOINREP column (col 0) and row sums stay tile-local.
+The merge maxima themselves arrive as inputs: they are computed by the
+MXU level decomposition (ops/merge.py gossip_reductions_mxu), which
+replaced both this kernel's former in-kernel VPU accumulation loop and
+the standalone maxmerge Pallas kernel — one boolean matmul per
+distinct column value beats O(N³) VPU product-max by the measured
+end-to-end factor of ~2x at N=512.
+
+Grid is (R/TR,): row tiles spanning the full peer axis so the JOINREP
+column (col 0) and row sums stay tile-local.
 
 The kernel is differentially tested against the unfused XLA tick for
 bit-identical states, events, and accounting (tests/test_tickfused.py)
@@ -39,149 +40,113 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_SUB = 8  # sender sublane chunk of the merge loop
 
-
-def _kernel(t_remove: int, tr: int, ts_tile: int, n: int, with_events: bool,
-            num_k: int,
+def _kernel(t_remove: int, tr: int, n: int, with_events: bool,
             # inputs
             scalars_ref,                     # SMEM: [t]
-            d_ref,                           # (TR, TS)   recv_from k-tile
-            kn_s_ref, hb_s_ref, ts_s_ref,    # (TS, N)    sender payload tiles
-            dfull_ref,                       # (TR, N)    recv_from row tile
-            kn_ref, hb_ref, ts_ref,          # (TR, N)    receiver row tiles
+            ma_ref, mf_ref, mt_ref,          # (TR, N)  merge maxima (public,
+                                             #   FILL=-1 encodes "none")
+            dfull_ref,                       # (TR, N)  recv_from row tile
+            kn_ref, hb_ref, ts_ref,          # (TR, N)  receiver row tiles
             gossip_ref, gdrop_ref,           # (TR, N)
-            rowvec_ref,                      # (TR, 4)    [ops, jrep, -, -]
-            colvec_ref,                      # (4, N)     [jreq, live_hold, -, -]
-            # outputs (added/removed only in trace mode), then scratch
-            *refs):
+            rowvec_ref,                      # (TR, 4)  [ops, jrep, -, -]
+            colvec_ref,                      # (4, N)   [jreq, live_hold, -, -]
+            # outputs (added/removed only in trace mode)
+            *outs):
     if with_events:
         (kn_out, hb_out, ts_out, gossip_out, counters_out,
-         added_out, removed_out, m_a, m_f, m_t) = refs
+         added_out, removed_out) = outs
     else:
-        (kn_out, hb_out, ts_out, gossip_out, counters_out,
-         m_a, m_f, m_t) = refs
+        (kn_out, hb_out, ts_out, gossip_out, counters_out) = outs
         added_out = removed_out = None
-    k = pl.program_id(2)
-    # read outside the pl.when closures: the interpret-mode lowering
-    # resolves program_id only in the top-level kernel jaxpr
     i_tile = pl.program_id(0)
-
-    @pl.when(k == 0)
-    def _init():
-        m_a[:] = jnp.zeros_like(m_a)
-        m_f[:] = jnp.zeros_like(m_f)
-        m_t[:] = jnp.zeros_like(m_t)
-
     t = scalars_ref[0]
 
-    # ---- merge accumulation over this sender tile ------------------
-    kn_s = kn_s_ref[:]
-    hb_s = hb_s_ref[:]
-    ts_s = ts_s_ref[:]
-    a1 = kn_s * (hb_s + 1)
-    fresh = kn_s * (t - ts_s < t_remove)
-    f1 = fresh * (hb_s + 1)
-    t1 = fresh * (ts_s + 1)
-    d = d_ref[:]
-    a1x = jnp.expand_dims(a1, 0)
-    f1x = jnp.expand_dims(f1, 0)
-    t1x = jnp.expand_dims(t1, 0)
-    for r0 in range(0, tr, _SUB):
-        dx = jnp.expand_dims(d[r0:r0 + _SUB, :], 2)      # (8, TS, 1)
-        m_a[r0:r0 + _SUB, :] = jnp.maximum(
-            m_a[r0:r0 + _SUB, :], (dx * a1x).max(1))
-        m_f[r0:r0 + _SUB, :] = jnp.maximum(
-            m_f[r0:r0 + _SUB, :], (dx * f1x).max(1))
-        m_t[r0:r0 + _SUB, :] = jnp.maximum(
-            m_t[r0:r0 + _SUB, :], (dx * t1x).max(1))
+    m_all = ma_ref[:]
+    m_fr = mf_ref[:]
+    t_fr = mt_ref[:]
+    anyf = t_fr >= 0
 
-    # ---- epilogue: the whole tick update, once --------------------
-    @pl.when(k == num_k - 1)
-    def _epilogue():
-        m_all = m_a[:] - 1
-        m_fr = m_f[:] - 1
-        t_fr = m_t[:] - 1
-        anyf = m_t[:] > 0
+    grow = i_tile * tr + jax.lax.broadcasted_iota(jnp.int32, (tr, n), 0)
+    gcol = jax.lax.broadcasted_iota(jnp.int32, (tr, n), 1)
+    self_mask = grow == gcol
+    is_row0 = grow == 0
+    is_col0 = gcol == 0
 
-        grow = i_tile * tr + jax.lax.broadcasted_iota(
-            jnp.int32, (tr, n), 0)
-        gcol = jax.lax.broadcasted_iota(jnp.int32, (tr, n), 1)
-        self_mask = grow == gcol
-        is_row0 = grow == 0
-        is_col0 = gcol == 0
+    exists = kn_ref[:] > 0
+    hb0 = hb_ref[:]
+    ts0 = ts_ref[:]
+    dfull = dfull_ref[:] > 0
+    ops_r = rowvec_ref[:, 0:1] > 0                        # (TR, 1)
+    jrep_r = rowvec_ref[:, 1:2] > 0
+    jreq_c = jnp.expand_dims(colvec_ref[0, :], 0) > 0     # (1, N)
+    hold_c = jnp.expand_dims(colvec_ref[1, :], 0) > 0
 
-        exists = kn_ref[:] > 0
-        hb0 = hb_ref[:]
-        ts0 = ts_ref[:]
-        dfull = dfull_ref[:] > 0
-        ops_r = rowvec_ref[:, 0:1] > 0                        # (TR, 1)
-        jrep_r = rowvec_ref[:, 1:2] > 0
-        jreq_c = jnp.expand_dims(colvec_ref[0, :], 0) > 0     # (1, N)
-        hold_c = jnp.expand_dims(colvec_ref[1, :], 0) > 0
+    # merge into existing entries (MP1Node.cpp:248-251)
+    inc = exists & (m_all > hb0)
+    hb1 = jnp.where(inc, m_all, hb0)
+    ts1 = jnp.where(inc, t, ts0)
+    # piggyback add (MP1Node.cpp:282-301)
+    padd = (~exists) & anyf & (~self_mask)
+    hb1 = jnp.where(padd, m_all, hb1)
+    ts1 = jnp.where(padd, jnp.where(m_all > m_fr, t, t_fr), ts1)
+    known_pb = exists | padd
+    # direct-sender handling (MP1Node.cpp:236-242)
+    dinc = dfull & known_pb
+    hb1 = jnp.where(dinc, hb1 + 1, hb1)
+    ts1 = jnp.where(dinc, t, ts1)
+    dadd = dfull & (~known_pb) & (~self_mask)
+    hb1 = jnp.where(dadd, 1, hb1)
+    ts1 = jnp.where(dadd, t, ts1)
+    known2 = exists | padd | dadd
+    # JOINREQ at the introducer (row 0; MP1Node.cpp:221-230)
+    q_cell = is_row0 & jreq_c & (~known2) & (~is_col0)
+    known3 = known2 | q_cell
+    hb1 = jnp.where(q_cell, 1, hb1)
+    ts1 = jnp.where(q_cell, t, ts1)
+    # JOINREP at the joiner (col 0; MP1Node.cpp:231-233)
+    r_cell = is_col0 & jrep_r & (~known3)
+    known4 = known3 | r_cell
+    hb1 = jnp.where(r_cell, 1, hb1)
+    ts1 = jnp.where(r_cell, t, ts1)
+    # staleness detection (MP1Node.cpp:339-348)
+    stale = ops_r & known4 & (t - ts1 >= t_remove)
+    known5 = known4 & (~stale)
+    # dissemination + drop + in-flight hold
+    send = ops_r & known5
+    gsent = send & (gdrop_ref[:] == 0)
+    gossip_next = gsent | ((gossip_ref[:] > 0) & hold_c)
 
-        # merge into existing entries (MP1Node.cpp:248-251)
-        inc = exists & (m_all > hb0)
-        hb1 = jnp.where(inc, m_all, hb0)
-        ts1 = jnp.where(inc, t, ts0)
-        # piggyback add (MP1Node.cpp:282-301)
-        padd = (~exists) & anyf & (~self_mask)
-        hb1 = jnp.where(padd, m_all, hb1)
-        ts1 = jnp.where(padd, jnp.where(m_all > m_fr, t, t_fr), ts1)
-        known_pb = exists | padd
-        # direct-sender handling (MP1Node.cpp:236-242)
-        dinc = dfull & known_pb
-        hb1 = jnp.where(dinc, hb1 + 1, hb1)
-        ts1 = jnp.where(dinc, t, ts1)
-        dadd = dfull & (~known_pb) & (~self_mask)
-        hb1 = jnp.where(dadd, 1, hb1)
-        ts1 = jnp.where(dadd, t, ts1)
-        known2 = exists | padd | dadd
-        # JOINREQ at the introducer (row 0; MP1Node.cpp:221-230)
-        q_cell = is_row0 & jreq_c & (~known2) & (~is_col0)
-        known3 = known2 | q_cell
-        hb1 = jnp.where(q_cell, 1, hb1)
-        ts1 = jnp.where(q_cell, t, ts1)
-        # JOINREP at the joiner (col 0; MP1Node.cpp:231-233)
-        r_cell = is_col0 & jrep_r & (~known3)
-        known4 = known3 | r_cell
-        hb1 = jnp.where(r_cell, 1, hb1)
-        ts1 = jnp.where(r_cell, t, ts1)
-        # staleness detection (MP1Node.cpp:339-348)
-        stale = ops_r & known4 & (t - ts1 >= t_remove)
-        known5 = known4 & (~stale)
-        # dissemination + drop + in-flight hold
-        send = ops_r & known5
-        gsent = send & (gdrop_ref[:] == 0)
-        gossip_next = gsent | ((gossip_ref[:] > 0) & hold_c)
-
-        kn_out[:] = known5.astype(jnp.int32)
-        hb_out[:] = hb1
-        ts_out[:] = ts1
-        gossip_out[:] = gossip_next.astype(jnp.int32)
-        sent_row = gsent.astype(jnp.int32).sum(1)
-        counters_out[:] = jnp.where(
-            jax.lax.broadcasted_iota(jnp.int32, (tr, 128), 1) == 0,
-            jnp.expand_dims(sent_row, 1), 0)
-        if with_events:
-            added_out[:] = (known4 & (~exists)).astype(jnp.int32)
-            removed_out[:] = stale.astype(jnp.int32)
+    kn_out[:] = known5.astype(jnp.int32)
+    hb_out[:] = hb1
+    ts_out[:] = ts1
+    gossip_out[:] = gossip_next.astype(jnp.int32)
+    sent_row = gsent.astype(jnp.int32).sum(1)
+    counters_out[:] = jnp.where(
+        jax.lax.broadcasted_iota(jnp.int32, (tr, 128), 1) == 0,
+        jnp.expand_dims(sent_row, 1), 0)
+    if with_events:
+        added_out[:] = (known4 & (~exists)).astype(jnp.int32)
+        removed_out[:] = stale.astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("t_remove", "tile_r", "tile_s",
+@functools.partial(jax.jit, static_argnames=("t_remove", "tile_r",
                                              "with_events", "interpret"))
-def fused_tick_update(recv_from, known, hb, ts, gossip, gdrop,
+def fused_tick_update(m_all, m_fresh, t_fresh, recv_from,
+                      known, hb, ts, gossip, gdrop,
                       ops, jrep, jreq, live_hold, t, *,
-                      t_remove: int, tile_r: int = 64, tile_s: int = 128,
+                      t_remove: int, tile_r: int = 64,
                       with_events: bool = True,
                       interpret: bool | None = None):
-    """One fused pass: merge + membership update + detection + send.
+    """One fused pass over the post-merge tick update.
 
-    Args mirror core/tick.py's intermediates: ``recv_from`` [R, S]
-    delivery, ``known/hb/ts`` the post-wipe state tables, ``gossip``
-    the in-flight matrix, ``gdrop`` this tick's gossip drop mask,
-    ``ops``/``jrep`` per-row vectors, ``jreq``/``live_hold`` per-column
-    vectors, ``t`` the clock.
+    ``m_all/m_fresh/t_fresh`` are the public merge maxima
+    (gossip_reductions / gossip_reductions_mxu contract, FILL=-1);
+    the other args mirror core/tick.py's intermediates: ``recv_from``
+    [R, S] delivery, ``known/hb/ts`` the post-wipe state tables,
+    ``gossip`` the in-flight matrix, ``gdrop`` this tick's gossip drop
+    mask, ``ops``/``jrep`` per-row vectors, ``jreq``/``live_hold``
+    per-column vectors, ``t`` the clock.
 
     Returns (known', hb', ts', gossip', sent_row[N], added, removed);
     ``added``/``removed`` are None when ``with_events`` is False.
@@ -190,9 +155,7 @@ def fused_tick_update(recv_from, known, hb, ts, gossip, gdrop,
         interpret = jax.default_backend() != "tpu"
     n = known.shape[0]
     tr = min(tile_r, n)
-    tss = min(tile_s, n)
-    assert n % tr == 0 and n % tss == 0 and tss % _SUB == 0 \
-        and tr % _SUB == 0, (n, tr, tss)
+    assert n % tr == 0 and tr % 8 == 0, (n, tr)
 
     i32 = jnp.int32
     rowvec = jnp.stack([ops.astype(i32), jrep.astype(i32),
@@ -200,11 +163,11 @@ def fused_tick_update(recv_from, known, hb, ts, gossip, gdrop,
     colvec = jnp.stack([jreq.astype(i32), live_hold.astype(i32),
                         jnp.zeros(n, i32), jnp.zeros(n, i32)])
 
-    grid = (n // tr, 1, n // tss)
-    row_tile = pl.BlockSpec((tr, n), lambda i, j, k: (i, 0),
+    grid = (n // tr,)
+    row_tile = pl.BlockSpec((tr, n), lambda i: (i, 0),
                             memory_space=pltpu.VMEM)
     out_specs = [row_tile, row_tile, row_tile, row_tile,
-                 pl.BlockSpec((tr, 128), lambda i, j, k: (i, 0),
+                 pl.BlockSpec((tr, 128), lambda i: (i, 0),
                               memory_space=pltpu.VMEM)]
     out_shape = [jax.ShapeDtypeStruct((n, n), i32)] * 4 \
         + [jax.ShapeDtypeStruct((n, 128), i32)]
@@ -213,34 +176,24 @@ def fused_tick_update(recv_from, known, hb, ts, gossip, gdrop,
         out_shape += [jax.ShapeDtypeStruct((n, n), i32)] * 2
 
     outs = pl.pallas_call(
-        functools.partial(_kernel, t_remove, tr, tss, n, with_events,
-                          n // tss),
+        functools.partial(_kernel, t_remove, tr, n, with_events),
         grid=grid,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),                # scalars
-            pl.BlockSpec((tr, tss), lambda i, j, k: (i, k),
-                         memory_space=pltpu.VMEM),                # d k-tile
-            pl.BlockSpec((tss, n), lambda i, j, k: (k, 0),
-                         memory_space=pltpu.VMEM),                # kn sender
-            pl.BlockSpec((tss, n), lambda i, j, k: (k, 0),
-                         memory_space=pltpu.VMEM),                # hb sender
-            pl.BlockSpec((tss, n), lambda i, j, k: (k, 0),
-                         memory_space=pltpu.VMEM),                # ts sender
+            row_tile, row_tile, row_tile,                         # maxima
             row_tile,                                             # dfull
-            row_tile, row_tile, row_tile,                         # kn/hb/ts row
+            row_tile, row_tile, row_tile,                         # kn/hb/ts
             row_tile, row_tile,                                   # gossip gdrop
-            pl.BlockSpec((tr, 4), lambda i, j, k: (i, 0),
+            pl.BlockSpec((tr, 4), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),                # rowvec
-            pl.BlockSpec((4, n), lambda i, j, k: (0, 0),
+            pl.BlockSpec((4, n), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),                # colvec
         ],
         out_specs=out_specs,
         out_shape=out_shape,
-        scratch_shapes=[pltpu.VMEM((tr, n), i32)] * 3,
         interpret=interpret,
     )(jnp.asarray([t], i32),
-      recv_from.astype(i32),
-      known.astype(i32), hb.astype(i32), ts.astype(i32),
+      m_all.astype(i32), m_fresh.astype(i32), t_fresh.astype(i32),
       recv_from.astype(i32),
       known.astype(i32), hb.astype(i32), ts.astype(i32),
       gossip.astype(i32), gdrop.astype(i32),

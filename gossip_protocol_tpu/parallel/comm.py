@@ -19,10 +19,11 @@ collective-communication abstraction over the peer-sharded state:
   inside a slice / DCN across slices; nothing here assumes either.
 
 Both backends take ``use_pallas``: True routes the merge reduction
-through the fused Pallas TPU kernel (ops/pallas/maxmerge.py), False
-through the blockwise XLA op (ops/merge.py), None picks by backend
-(Pallas on TPU).  The two implementations share one output contract and
-are differentially tested against each other (tests/test_pallas.py).
+through the MXU level decomposition (ops/merge.py
+gossip_reductions_mxu — one boolean matmul per distinct column value),
+False through the blockwise VPU XLA op, None picks by backend (MXU on
+TPU).  The two implementations share one output contract and are
+differentially tested against each other (tests/test_pallas.py).
 
 The tick body is written once against this interface; sharding is a
 deployment choice, not a code path fork.
@@ -45,13 +46,8 @@ def _resolve_use_pallas(use_pallas):
 
 def _merge_fn(use_pallas: bool):
     if use_pallas:
-        from ..ops.pallas.maxmerge import gossip_reductions_pallas
-
-        def run(recv_from, known, hb, ts, now, *, t_remove, block_size):
-            return gossip_reductions_pallas(
-                recv_from, known, hb, ts, now, t_remove=t_remove,
-                tile_s=block_size)
-        return run
+        from ..ops.merge import gossip_reductions_mxu
+        return gossip_reductions_mxu
     return gossip_reductions
 
 

@@ -1,19 +1,21 @@
-"""Differential tests: the fused Pallas merge kernel vs the XLA op.
+"""Differential tests: the MXU level-decomposition merge vs the
+blockwise VPU XLA op.
 
-Both implement the same contract (ops/merge.py docstring); the Pallas
-kernel runs in interpret mode on CPU so the parity holds on every
-backend the suite runs on.  Shapes include non-tile-multiples to
-exercise the padding path, and a full end-to-end run compares the two
-merge implementations through the whole simulation.
+Both implement the same contract (ops/merge.py docstring): exact
+masked maxima over the sender axis.  The MXU form resolves one
+distinct column value per iteration with a boolean matmul, so the
+tests include value distributions from degenerate (all equal — one
+level) to adversarial (all distinct — N levels), plus shapes that are
+not tile multiples and a full end-to-end run through the whole
+simulation.
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from gossip_protocol_tpu.ops.merge import gossip_reductions
-from gossip_protocol_tpu.ops.pallas.maxmerge import gossip_reductions_pallas
+from gossip_protocol_tpu.ops.merge import (gossip_reductions,
+                                           gossip_reductions_mxu)
 
 
 def _random_inputs(rng, r, s, j, t_now=50, t_remove=20):
@@ -27,38 +29,59 @@ def _random_inputs(rng, r, s, j, t_now=50, t_remove=20):
 
 @pytest.mark.parametrize("r,s,j", [
     (8, 8, 128),        # exactly one tile
-    (16, 24, 128),      # sender axis pads to sublane multiple
-    (10, 10, 10),       # tiny odd shape (reference N=10), pads everywhere
-    (64, 64, 200),      # j pads to lane multiple
-    (130, 64, 130),     # r and j pad across tile boundaries
+    (16, 24, 128),      # sender axis not a receiver multiple
+    (10, 10, 10),       # tiny odd shape (reference N=10)
+    (64, 64, 200),      # j not a lane multiple
+    (130, 64, 130),     # r and j cross tile boundaries
 ])
 @pytest.mark.parametrize("seed", [0, 1])
-def test_pallas_matches_xla(r, s, j, seed):
+def test_mxu_reductions_match(r, s, j, seed):
     rng = np.random.default_rng(seed)
     recv, known, hb, ts = _random_inputs(rng, r, s, j)
     now = jnp.int32(50)
     ref = gossip_reductions(recv, known, hb, ts, now,
                             t_remove=20, block_size=16)
-    got = gossip_reductions_pallas(recv, known, hb, ts, now,
-                                   t_remove=20, interpret=True)
+    got = gossip_reductions_mxu(recv, known, hb, ts, now, t_remove=20)
     for a, b, name in zip(ref, got, ["m_all", "m_fr", "t_fr", "anyf"]):
         assert np.array_equal(np.asarray(a), np.asarray(b)), name
 
 
-def test_pallas_no_contributions():
+@pytest.mark.parametrize("spread", ["one_level", "adversarial"])
+def test_mxu_reductions_value_spread(spread):
+    """Degenerate (single distinct value -> 1 iteration) and
+    adversarial (every sender distinct -> S iterations) columns."""
+    rng = np.random.default_rng(3)
+    s = j = 48
+    recv = jnp.asarray(rng.random((s, s)) < 0.5)
+    known = jnp.asarray(rng.random((s, j)) < 0.7)
+    if spread == "one_level":
+        hb = jnp.full((s, j), 17, jnp.int32) * known
+    else:
+        hb = jnp.asarray((np.arange(s)[:, None] + np.arange(j)[None, :] + 1)
+                         .astype(np.int32)) * known
+    ts = jnp.asarray(rng.integers(30, 50, size=(s, j)).astype(np.int32)) * known
+    now = jnp.int32(50)
+    ref = gossip_reductions(recv, known, hb, ts, now,
+                            t_remove=20, block_size=16)
+    got = gossip_reductions_mxu(recv, known, hb, ts, now, t_remove=20)
+    for a, b, name in zip(ref, got, ["m_all", "m_fr", "t_fr", "anyf"]):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), name
+
+
+def test_mxu_no_contributions():
     """All-empty delivery must yield FILL everywhere and anyf False."""
     n = 16
     z = jnp.zeros((n, n), bool)
     zi = jnp.zeros((n, n), jnp.int32)
-    m_all, m_fr, t_fr, anyf = gossip_reductions_pallas(
-        z, z, zi, zi, jnp.int32(5), t_remove=20, interpret=True)
+    m_all, m_fr, t_fr, anyf = gossip_reductions_mxu(
+        z, z, zi, zi, jnp.int32(5), t_remove=20)
     assert (np.asarray(m_all) == -1).all()
     assert (np.asarray(m_fr) == -1).all()
     assert (np.asarray(t_fr) == -1).all()
     assert not np.asarray(anyf).any()
 
 
-def test_end_to_end_pallas_matches_xla():
+def test_end_to_end_mxu_matches_xla():
     """A full scenario run must produce identical events and final
     state with either merge implementation."""
     from gossip_protocol_tpu.core.sim import Simulation

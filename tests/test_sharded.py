@@ -44,6 +44,28 @@ def test_sharded_equals_local(scen):
 
 
 @needs_devices
+def test_sharded_mxu_merge_equals_local():
+    """RingComm with use_pallas=True (the TPU default resolution)
+    routes the ring merge through the MXU level decomposition; it must
+    trace under shard_map (input-derived while_loop carry inits — a
+    constant init trips the varying-axes typing) and match the local
+    run bit-for-bit."""
+    cfg = scenario_cfg("msgdropsinglefailure", max_nnb=16, seed=3,
+                       total_ticks=150)
+    sched = make_schedule(cfg)
+    local = Simulation(cfg).run()
+    mesh = make_mesh(4)
+    run = make_sharded_run(cfg, mesh, use_pallas=True)
+    final, ev = run(shard_state(init_state(cfg), mesh), sched)
+    np.testing.assert_array_equal(np.asarray(ev.added), local.added)
+    np.testing.assert_array_equal(np.asarray(ev.removed), local.removed)
+    for f in ("known", "hb", "ts", "in_group", "own_hb", "gossip"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(final, f)),
+            np.asarray(getattr(local.final_state, f)), err_msg=f)
+
+
+@needs_devices
 def test_sharded_mesh_sizes():
     """The ring must be correct for any axis size dividing N."""
     cfg = scenario_cfg("singlefailure", max_nnb=12, seed=1, total_ticks=60)

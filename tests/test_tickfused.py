@@ -1,8 +1,9 @@
-"""Differential tests for the fused tick kernel (ops/pallas/tickfused.py):
-the single-launch merge+update+detect+send pass must be bit-identical
-to the composable-op tick — states, events, and accounting — across
-scenario shapes (interpret mode on CPU; the same comparison passes on
-real TPU hardware against the Mosaic-compiled kernel)."""
+"""Differential tests for the fused tick path (MXU merge +
+ops/pallas/tickfused.py epilogue kernel): the update+detect+send pass
+must be bit-identical to the composable-op tick — states, events, and
+accounting — across scenario shapes (interpret mode on CPU; the same
+comparison passes on real TPU hardware against the Mosaic-compiled
+kernel)."""
 
 import dataclasses
 
@@ -62,10 +63,11 @@ def test_fused_gate_falls_back_on_odd_n():
 
 @pytest.mark.slow
 def test_fused_multi_tile_grid_parity():
-    """Exercise the kernel's real tiling machinery: at N=256 the grid
-    has 4 row tiles and 2 sender steps, so the cross-k scratch
-    accumulation and the k==0 / k==num_k-1 gating are live (at tiny N
-    they degenerate to a single program).  Covers both event modes."""
+    """Exercise the epilogue kernel's real tiling: at N=256 the grid
+    has 4 row tiles, so the per-tile global-index math (is_row0, the
+    self-diagonal, JOINREP col 0) runs on non-first tiles (at tiny N
+    everything degenerates to a single program).  Covers both event
+    modes."""
     cfg = SimConfig(max_nnb=256, single_failure=False, drop_msg=True,
                     msg_drop_prob=0.1, seed=5, total_ticks=40,
                     fail_tick=15)
