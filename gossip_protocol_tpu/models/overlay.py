@@ -278,9 +278,12 @@ def resolved_dims(cfg: SimConfig):
     Auto sizing: K ~ 4*log2 N for view capacity (capped at 64).  Every
     message carries the sender's whole K-slot view (lane-aligned
     merges), so each exchange supplies ~1 candidate per occupied slot
-    and the per-slot supply per tick is ~F·occupancy — F = 4 keeps
+    and the per-slot supply per tick is ~F·occupancy — F = 3 keeps
     slot refresh ahead of the TREMOVE horizon with margin for a 10%
-    drop window.  ``cfg.overlay_sample`` (the L-window of the earlier
+    drop window (measured: zero false removals and zero coverage gaps
+    at 65k/20%-churn and 4096/10%-drop; direct self-entries only need
+    one of the F sends to land, P[all dropped] = 1e-3 at 10% drop).
+    ``cfg.overlay_sample`` (the L-window of the earlier
     per-receiver-hash design) is accepted but ignored.
     """
     n = cfg.n
@@ -294,7 +297,7 @@ def resolved_dims(cfg: SimConfig):
         # ~1.9 at alpha=2.5 — leaves gossip rarely, hubs every round
         f = 8
     else:
-        f = 4
+        f = 3
     return k, f
 
 
@@ -473,6 +476,7 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
         use_pallas = jax.default_backend() == "tpu"
     use_kernel = bool(use_pallas) and isinstance(comm, LocalOverlayComm)
     powerlaw = cfg.topology == "powerlaw"
+    can_rejoin = cfg.churn_rate > 0 or cfg.rejoin_after is not None
     n = cfg.n
     k, f = resolved_dims(cfg)
     t_remove = cfg.t_remove
@@ -540,7 +544,8 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
         rejoin = sched.rejoin_of(rows)
         failed = (t > fail) & (t <= rejoin)
         proc = (t > start) & ~failed
-        rejoining = t == rejoin
+        rejoining = (t == rejoin) if can_rejoin \
+            else jnp.zeros_like(start, bool)
 
         # local row block
         row_start = comm.row_start(n)
@@ -550,12 +555,18 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
         keep_l = comm.slice_rows(~rejoining)
 
         # ---- churn wipe (same semantics as core/tick.py) -----------
-        keep = ~rejoining
-        ids0 = jnp.where(keep_l[:, None], state.ids, -1)
-        hb0 = state.hb * keep_l[:, None]
-        ts0 = state.ts * keep_l[:, None]
-        in_group0 = state.in_group & keep
-        own_hb0 = state.own_hb * keep
+        # statically compiled out when no config path can rejoin — at
+        # 1M peers the wipe's (N, K) selects are measurable dead work
+        if can_rejoin:
+            keep = ~rejoining
+            ids0 = jnp.where(keep_l[:, None], state.ids, -1)
+            hb0 = state.hb * keep_l[:, None]
+            ts0 = state.ts * keep_l[:, None]
+            in_group0 = state.in_group & keep
+            own_hb0 = state.own_hb * keep
+        else:
+            ids0, hb0, ts0 = state.ids, state.hb, state.ts
+            in_group0, own_hb0 = state.in_group, state.own_hb
         own_hb0_l = comm.slice_rows(own_hb0)
 
         # ---- payload of the send tick t-1 --------------------------
@@ -569,15 +580,75 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
         # packed (ts, hb) payload word (exactly the merge's `p` value),
         # which halves the permutation width vs separate hb/ts planes.
         p0 = jnp.where(ids0 >= 0, _pack_th(ts0, hb0), 0)
+
+        # ---- vector decisions (pure functions of carried state) ----
+        jrep = state.joinrep & proc
+        jrep_l = comm.slice_rows(jrep)
+        jreq = state.joinreq & proc[INTRODUCER]
+        in_group = in_group0 | jrep
+        starting = (t == start) | rejoining
+        in_group = in_group | (starting & intro_onehot)
+        ops = proc & in_group
+        own_hb = own_hb0 + ops.astype(jnp.int32)
+        ops_l = comm.slice_rows(ops)
+        rows_gu_all = rows.astype(jnp.uint32)
+
+        # JOINREQ per-slot aggregates at the introducer: requester
+        # entries (j, hb=1, ts=t) reduced to (K,) maxima by a dense
+        # (K, N) one-hot max (addMember, MP1Node.cpp:265-280)
+        q_slot = _slot_of(seed, slot_ep, rows, k)
+        q_key = jnp.where(jreq & ~intro_onehot,
+                          _pack_key_direct(t, rows,
+                                           jnp.broadcast_to(t, (n,))), 0)
+        q_match = q_slot[None, :] == kk[:, None]             # (K, N)
+        q_kf = (q_match * q_key[None, :]).max(1)             # (K,)
+        q_sel = q_match & (q_key[None, :] == q_kf[:, None]) & (q_kf > 0)[:, None]
+        q_pf = jnp.where(q_sel.any(1), _pack_th(t, 1), 0)    # all (t, hb=1)
+
+        joins_recv = jrep.sum().astype(jnp.int32) \
+            + jreq.sum().astype(jnp.int32)
+
+        # the partner self-entry's age is exactly 1 tick, so its
+        # freshness gate is static in t_remove
+        self_entry_fresh = t_remove > 1
+
         if use_kernel:
-            # integer payload for the Pallas kernel: the butterfly
-            # moves rows without arithmetic, so no float casts (and no
-            # matmul-precision hazard) anywhere.  All F per-round send
-            # flags ride along as trailing columns.
-            payload = jnp.concatenate([
-                ids0, p0, own_hb0_l[:, None],
-                state.send_flags.astype(jnp.int32),
-            ], 1)   # (Nl, 2K+1+F)
+            # ---- the whole (N, K) phase in one Pallas launch -------
+            # (ops/pallas/overlay_exchange.py): accumulator init +
+            # proc gating + F exchange rounds + JOINREP/JOINREQ +
+            # winner extraction + detection + per-row metric counts
+            from ..ops.pallas.overlay_exchange import fused_overlay_tick
+            masks = jnp.stack([exchange_mask(seed, t - 1, fi, n)
+                               for fi in range(f)])
+            i32 = jnp.int32
+            bits = (proc.astype(i32) | (ops.astype(i32) << 1)
+                    | (jrep.astype(i32) << 2))
+            idsaux = jnp.concatenate([
+                ids0, own_hb0_l[:, None], bits[:, None],
+                state.send_flags.astype(i32)], 1)      # (N, K+2+F)
+            zk = jnp.zeros((k,), i32)
+            intro = jnp.stack([
+                ids0[INTRODUCER], p0[INTRODUCER],
+                jnp.zeros((k,), i32).at[0].set(own_hb0[INTRODUCER]),
+                q_kf.astype(i32), q_pf,
+                zk, zk, zk])                           # (8, K)
+            scalars = jnp.stack([
+                t, seed.astype(i32), sched.victim_lo, sched.victim_hi,
+                sched.fail_tick, sched.rejoin_after,
+                sched.churn_thr.astype(i32), sched.churn_after])
+            ids2, hb2, ts2, ctr = fused_overlay_tick(
+                idsaux, p0, intro, masks, scalars,
+                k=k, t_remove=t_remove,
+                churn_lo=cfg.total_ticks // 4,
+                churn_span=max(cfg.total_ticks // 2, 1))
+            recv_cnt = ctr[:, 0].sum() + joins_recv
+            removals = ctr[:, 1].sum()
+            false_removals = ctr[:, 2].sum()
+            victims_cnt = ctr[:, 3].sum()
+            adds_cnt = ctr[:, 4].sum()
+            view_cnt = ctr[:, 5].sum()
+            ids_pre = ids2      # pre-re-roll table (kernel output is
+            #                     pre-remap; the re-roll runs below)
         else:
             payload = jnp.concatenate([
                 ids0.astype(jnp.float32),
@@ -585,67 +656,56 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
                 own_hb0_l.astype(jnp.float32)[:, None],
             ], 1)   # (Nl, 2K+1); the per-round in-flight flag is appended below
 
-        # ---- merge phase: lane-aligned (Nl, K) max per partner -----
-        # Incoming tables are slotted by the same global map, so the
-        # merge is a plain per-lane lexicographic (key, payload) max —
-        # no slot-match product.  The winner's (ts, hb) travel as one
-        # packed int32 ((ts+1) << 12 | hb+1; both < 4095 because runs
-        # are capped at 4094 ticks); among equal-priority-key
-        # candidates the lexicographic (ts, hb) max wins, which the
-        # oracle mirrors.
-        cur_key = jnp.where(ids0 >= 0,
-                            _pack_key(seed, t, rows_u[:, None], ids0, ts0),
-                            0)
-        keymax = cur_key
-        p_acc = p0
-        # zero derived from a shard-local value so the exchange scan's
-        # carry is shard-varying from the start (shard_map VMA typing)
-        recv_cnt = (proc_l.sum() * 0).astype(jnp.int32)
+            # ---- merge phase: lane-aligned (Nl, K) max per partner -
+            # Incoming tables are slotted by the same global map, so
+            # the merge is a plain per-lane lexicographic
+            # (key, payload) max — no slot-match product.  The
+            # winner's (ts, hb) travel as one packed int32
+            # ((ts+1) << 12 | hb+1; both < 4095 because runs are
+            # capped at 4094 ticks); among equal-priority-key
+            # candidates the lexicographic (ts, hb) max wins, which
+            # the oracle mirrors.
+            cur_key = jnp.where(ids0 >= 0,
+                                _pack_key(seed, t, rows_u[:, None],
+                                          ids0, ts0),
+                                0)
+            keymax = cur_key
+            p_acc = p0
+            # zero derived from a shard-local value so the exchange
+            # scan's carry is shard-varying from the start (shard_map
+            # VMA typing)
+            recv_cnt = (proc_l.sum() * 0).astype(jnp.int32)
 
-        def lex_merge(keymax, p_acc, key_c, p_c):
-            better = (key_c > keymax) | ((key_c == keymax) & (p_c > p_acc))
-            return (jnp.where(better, key_c, keymax),
-                    jnp.where(better, p_c, p_acc))
+            def lex_merge(keymax, p_acc, key_c, p_c):
+                better = (key_c > keymax) \
+                    | ((key_c == keymax) & (p_c > p_acc))
+                return (jnp.where(better, key_c, keymax),
+                        jnp.where(better, p_c, p_acc))
 
-        def table_merge(keymax, p_acc, c_id, c_ts, c_p, valid):
-            """Merge an identically-slotted (Nl, K) view, lane-aligned.
+            def table_merge(keymax, p_acc, c_id, c_ts, c_p, valid):
+                """Merge an identically-slotted (Nl, K) view.
 
-            ``c_p`` is the already-packed (ts, hb) payload word — the
-            wire format and the merge tiebreak value coincide."""
-            key = jnp.where(valid,
-                            _pack_key(seed, t, rows_u[:, None], c_id, c_ts),
-                            jnp.uint32(0))
-            return lex_merge(keymax, p_acc, key,
-                             jnp.where(valid, c_p, 0))
+                ``c_p`` is the already-packed (ts, hb) payload word —
+                the wire format and the merge tiebreak coincide."""
+                key = jnp.where(valid,
+                                _pack_key(seed, t, rows_u[:, None],
+                                          c_id, c_ts),
+                                jnp.uint32(0))
+                return lex_merge(keymax, p_acc, key,
+                                 jnp.where(valid, c_p, 0))
 
-        def entry_merge(keymax, p_acc, subj, e_ts, e_hb, ok):
-            """Merge one DIRECT (subject, ts, hb) entry per local row."""
-            sl = _slot_of(seed, slot_ep, subj, k)
-            key = jnp.where(ok, _pack_key_direct(t, subj, e_ts),
-                            jnp.uint32(0))
-            p = jnp.where(ok, _pack_th(e_ts, e_hb), 0)
-            match = sl[:, None] == kk[None, :]
-            return lex_merge(keymax, p_acc,
-                             jnp.where(match, key[:, None], jnp.uint32(0)),
-                             jnp.where(match, p[:, None], 0))
+            def entry_merge(keymax, p_acc, subj, e_ts, e_hb, ok):
+                """Merge one DIRECT (subject, ts, hb) entry per row."""
+                sl = _slot_of(seed, slot_ep, subj, k)
+                key = jnp.where(ok, _pack_key_direct(t, subj, e_ts),
+                                jnp.uint32(0))
+                p = jnp.where(ok, _pack_th(e_ts, e_hb), 0)
+                match = sl[:, None] == kk[None, :]
+                return lex_merge(
+                    keymax, p_acc,
+                    jnp.where(match, key[:, None], jnp.uint32(0)),
+                    jnp.where(match, p[:, None], 0))
 
-        # the partner self-entry's age is exactly 1 tick, so its
-        # freshness gate is static in t_remove
-        self_entry_fresh = t_remove > 1
-
-        if use_kernel:
-            from ..ops.pallas.overlay_exchange import fused_exchange_merge
-            masks = jnp.stack([exchange_mask(seed, t - 1, fi, n)
-                               for fi in range(f)])
-            kmax_k, pacc_k, recv_row = fused_exchange_merge(
-                payload, cur_key, p_acc, masks, t, seed,
-                k=k, t_remove=t_remove)
-            # the kernel merges every row; discard non-processing
-            # receivers' accumulators (bit-equal to gating `valid`)
-            keymax = jnp.where(proc_l[:, None], kmax_k, keymax)
-            p_acc = jnp.where(proc_l[:, None], pacc_k, p_acc)
-            recv_cnt = (recv_row * proc_l.astype(jnp.int32)).sum()
-        else:
             # rounds are structurally identical, so scan over the mask
             # axis instead of unrolling — XLA's CPU pipeline was
             # observed to hang compiling >= 8 unrolled rounds, and the
@@ -679,59 +739,64 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
             (keymax, p_acc, recv_cnt), _ = jax.lax.scan(
                 exchange_round, (keymax, p_acc, recv_cnt),
                 (masks, state.send_flags.astype(jnp.float32).T))
-        recv_cnt = comm.psum(recv_cnt)
+            recv_cnt = comm.psum(recv_cnt)
 
-        # ---- JOINREP consumption (introducer's payload broadcast) --
-        jrep = state.joinrep & proc
-        jrep_l = comm.slice_rows(jrep)
-        bc = comm.bcast_row0(payload)                # (2K+1,) introducer row
-        b_ids = jnp.broadcast_to(bc[:k].astype(jnp.int32), (nl, k))
-        b_p = jnp.broadcast_to(bc[k:2 * k].astype(jnp.int32), (nl, k))
-        b_ts = (b_p >> 12) - 1
-        j_valid = jrep_l[:, None] & (b_ids >= 0) & (t - b_ts < t_remove) \
-            & (b_ids != rows_g[:, None])
-        keymax, p_acc = table_merge(keymax, p_acc, b_ids, b_ts, b_p, j_valid)
-        if self_entry_fresh:
-            intro_vec = jnp.broadcast_to(jnp.int32(INTRODUCER), (nl,))
-            keymax, p_acc = entry_merge(
-                keymax, p_acc, intro_vec, jnp.broadcast_to(t - 1, (nl,)),
-                jnp.broadcast_to(bc[2 * k].astype(jnp.int32), (nl,)),
-                jrep_l & (intro_vec != rows_g))
-        in_group = in_group0 | jrep
+            # ---- JOINREP (introducer's payload broadcast) ----------
+            bc = comm.bcast_row0(payload)            # (2K+1,) introducer
+            b_ids = jnp.broadcast_to(bc[:k].astype(jnp.int32), (nl, k))
+            b_p = jnp.broadcast_to(bc[k:2 * k].astype(jnp.int32), (nl, k))
+            b_ts = (b_p >> 12) - 1
+            j_valid = jrep_l[:, None] & (b_ids >= 0) \
+                & (t - b_ts < t_remove) & (b_ids != rows_g[:, None])
+            keymax, p_acc = table_merge(keymax, p_acc, b_ids, b_ts, b_p,
+                                        j_valid)
+            if self_entry_fresh:
+                intro_vec = jnp.broadcast_to(jnp.int32(INTRODUCER), (nl,))
+                keymax, p_acc = entry_merge(
+                    keymax, p_acc, intro_vec,
+                    jnp.broadcast_to(t - 1, (nl,)),
+                    jnp.broadcast_to(bc[2 * k].astype(jnp.int32), (nl,)),
+                    jrep_l & (intro_vec != rows_g))
 
-        # ---- JOINREQ at the introducer -----------------------------
-        # requester entries (j, hb=1, ts=t) merged into (the shard
-        # holding) row 0 as a dense (K, N) masked max (addMember,
-        # MP1Node.cpp:265-280)
-        jreq = state.joinreq & proc[INTRODUCER]
-        rows_gu_all = rows.astype(jnp.uint32)
-        q_slot = _slot_of(seed, slot_ep, rows, k)
-        q_key = jnp.where(jreq & ~intro_onehot,
-                          _pack_key_direct(t, rows,
-                                           jnp.broadcast_to(t, (n,))), 0)
-        q_match = q_slot[None, :] == kk[:, None]             # (K, N)
-        q_kf = (q_match * q_key[None, :]).max(1)             # (K,)
-        q_sel = q_match & (q_key[None, :] == q_kf[:, None]) & (q_kf > 0)[:, None]
-        q_pf = jnp.where(q_sel.any(1), _pack_th(t, 1), 0)    # all (t, hb=1)
-        on0 = comm.on_first_shard()
-        row0_new = jnp.where(on0, jnp.maximum(keymax[0], q_kf), keymax[0])
-        same0 = on0 & (q_kf == row0_new)
-        was0 = keymax[0] == row0_new
-        p0_row = jnp.where(same0,
-                           jnp.maximum(q_pf, jnp.where(was0, p_acc[0], 0)),
-                           p_acc[0])
-        keymax = keymax.at[0].set(row0_new)
-        p_acc = p_acc.at[0].set(p0_row)
-        recv_cnt += jrep.sum().astype(jnp.int32) + jreq.sum().astype(jnp.int32)
+            # ---- JOINREQ aggregates into (the shard holding) row 0 -
+            on0 = comm.on_first_shard()
+            row0_new = jnp.where(on0, jnp.maximum(keymax[0], q_kf),
+                                 keymax[0])
+            same0 = on0 & (q_kf == row0_new)
+            was0 = keymax[0] == row0_new
+            p0_row = jnp.where(same0,
+                               jnp.maximum(q_pf,
+                                           jnp.where(was0, p_acc[0], 0)),
+                               p_acc[0])
+            keymax = keymax.at[0].set(row0_new)
+            p_acc = p_acc.at[0].set(p0_row)
+            recv_cnt += joins_recv
 
-        ids1 = jnp.where(keymax > 0,
-                         (keymax & ID_MASK).astype(jnp.int32) - 1, -1)
-        ts1 = jnp.where(keymax > 0, (p_acc >> 12) - 1, 0)
-        hb1 = jnp.where(keymax > 0, (p_acc & 0xFFF) - 1, 0)
+            ids1 = jnp.where(keymax > 0,
+                             (keymax & ID_MASK).astype(jnp.int32) - 1, -1)
+            ts1 = jnp.where(keymax > 0, (p_acc >> 12) - 1, 0)
+            hb1 = jnp.where(keymax > 0, (p_acc & 0xFFF) - 1, 0)
 
-        # ---- nodeStart / rejoin (replicated vector math) -----------
-        starting = (t == start) | rejoining
-        in_group = in_group | (starting & intro_onehot)
+            # ---- detection (nodeLoopOps analog) --------------------
+            stale = (ids1 >= 0) & (t - ts1 >= t_remove) & ops_l[:, None]
+            subj = jnp.clip(ids1, 0)
+            subj_fail = sched.fail_of(subj)
+            subj_failed = (t > subj_fail) & (t <= sched.rejoin_of(subj))
+            removals = comm.psum(stale.sum().astype(jnp.int32))
+            false_removals = comm.psum(
+                (stale & ~subj_failed).sum().astype(jnp.int32))
+            ids2 = jnp.where(stale, -1, ids1)
+            hb2 = jnp.where(stale, 0, hb1)
+            ts2 = jnp.where(stale, 0, ts1)
+            ids_pre = ids2      # pre-re-roll table for aligned metrics
+            victims_cnt = comm.psum(
+                ((ids_pre >= 0) & subj_failed & ~stale)
+                .sum().astype(jnp.int32))
+            adds_cnt = comm.psum(
+                ((ids1 != ids0) & (ids1 >= 0)).sum().astype(jnp.int32))
+            view_cnt = comm.psum((ids_pre >= 0).sum().astype(jnp.int32))
+
+        # ---- nodeStart / rejoin sends (replicated vector math) -----
         joinreq_new = starting & ~intro_onehot
         active = sched.drop_active(t)
         qdrop = mix32(seed, tu, rows_gu_all, np.uint32(_SALT_JOINREQ_DROP)) \
@@ -740,22 +805,6 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
             < sched.drop_thr
         joinreq_sent = joinreq_new & ~(active & qdrop)
         joinrep_sent = jreq & ~(active & pdrop)      # introducer's replies
-
-        # ---- detection (nodeLoopOps analog) ------------------------
-        ops = proc & in_group
-        own_hb = own_hb0 + ops.astype(jnp.int32)
-        ops_l = comm.slice_rows(ops)
-        stale = (ids1 >= 0) & (t - ts1 >= t_remove) & ops_l[:, None]
-        subj = jnp.clip(ids1, 0)
-        subj_fail = sched.fail_of(subj)
-        subj_failed = (t > subj_fail) & (t <= sched.rejoin_of(subj))
-        removals = comm.psum(stale.sum().astype(jnp.int32))
-        false_removals = comm.psum(
-            (stale & ~subj_failed).sum().astype(jnp.int32))
-        ids2 = jnp.where(stale, -1, ids1)
-        hb2 = jnp.where(stale, 0, hb1)
-        ts2 = jnp.where(stale, 0, ts1)
-        ids_pre = ids2          # pre-re-roll table for cell-aligned metrics
 
         # ---- slot-map re-roll at the SLOT_EPOCH boundary -----------
         # Every node re-slots its surviving entries into the next
@@ -836,13 +885,11 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
 
         metrics = OverlayMetrics(
             in_group=in_group.sum().astype(jnp.int32),
-            view_slots=comm.psum((ids_pre >= 0).sum().astype(jnp.int32)),
-            adds=comm.psum(
-                ((ids1 != ids0) & (ids1 >= 0)).sum().astype(jnp.int32)),
+            view_slots=view_cnt,
+            adds=adds_cnt,
             removals=removals,
             false_removals=false_removals,
-            victim_slots=comm.psum(
-                ((ids_pre >= 0) & subj_failed & ~stale).sum().astype(jnp.int32)),
+            victim_slots=victims_cnt,
             live_uncovered=live_uncovered,
             sent=sent,
             recv=recv_cnt,
@@ -872,7 +919,8 @@ def make_overlay_run(cfg: SimConfig, length: int | None = None,
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     key = (cfg.n, cfg.t_remove, length, resolved_dims(cfg), use_pallas,
-           cfg.topology)
+           cfg.topology, cfg.total_ticks,
+           cfg.churn_rate > 0 or cfg.rejoin_after is not None)
     if key in _OVERLAY_RUN_CACHE:
         return _OVERLAY_RUN_CACHE[key]
     tick = make_overlay_tick(cfg, use_pallas=use_pallas)
@@ -935,12 +983,12 @@ class OverlayResult:
     def node_ticks_per_second(self) -> float:
         return self.cfg.n * self.ticks_run / self.wall_seconds
 
-    def final_coverage(self):
-        """(live_uncovered_count, victim_entries_left) from the final
-        tables, computed on host — the large-N stand-in for the
-        per-tick coverage histogram.  Evaluated at the state's own
-        clock, so partial segments are judged against the schedule at
-        their stopping point."""
+    def uncovered_members(self):
+        """ids of live members present in NO view of the final tables
+        (host-side; the large-N stand-in for the per-tick coverage
+        histogram).  Evaluated at the state's own clock, so partial
+        segments are judged against the schedule at their stopping
+        point."""
         ids = np.asarray(self.final_state.ids)
         n = self.cfg.n
         t_end = int(np.asarray(self.final_state.tick))
@@ -955,9 +1003,19 @@ class OverlayResult:
         failed = (t_end > fail) & (t_end <= rejoin)
         in_group = np.asarray(self.final_state.in_group)
         live = in_group & ~failed & (i != INTRODUCER)
+        return np.flatnonzero(live & ~present)
+
+    def final_coverage(self):
+        """(live_uncovered_count, victim_entries_left) from the final
+        tables; see :meth:`uncovered_members`."""
+        ids = np.asarray(self.final_state.ids)
+        t_end = int(np.asarray(self.final_state.tick))
+        i = np.arange(self.cfg.n)
+        fail = np.asarray(self.sched.fail_of(jnp.asarray(i)))
+        rejoin = np.asarray(self.sched.rejoin_of(jnp.asarray(i)))
         flat = ids[ids >= 0]
         victim_left = int(((t_end > fail[flat]) & (t_end <= rejoin[flat])).sum())
-        return int((live & ~present).sum()), victim_left
+        return int(self.uncovered_members().size), victim_left
 
 
 class OverlaySimulation:

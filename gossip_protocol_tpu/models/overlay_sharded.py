@@ -120,7 +120,8 @@ def make_sharded_overlay_run(cfg: SimConfig, mesh: Mesh,
     scan-over-ticks inside ``shard_map`` over ``mesh``."""
     n_shards = mesh.devices.size
     key = (cfg.n, cfg.t_remove, cfg.total_ticks, cfg.overlay_view,
-           cfg.fanout, cfg.topology, axis, mesh)
+           cfg.fanout, cfg.topology,
+           cfg.churn_rate > 0 or cfg.rejoin_after is not None, axis, mesh)
     if key in _SHARDED_CACHE:
         return _SHARDED_CACHE[key]
 

@@ -1,40 +1,45 @@
-"""Fused Pallas TPU kernel: overlay XOR exchange + lane-aligned merge.
+"""Fused Pallas TPU kernel: the overlay tick's whole per-(N, K) phase.
 
-The overlay tick's hot phase (models/overlay.py) is, per exchange round
-``f``: permute the whole payload matrix by ``x[i ^ m_f]`` and fold the
-incoming view into the receiver's table.  The XLA formulation pays two
-HIGHEST-precision f32 permutation matmuls of O(sqrt(N)) contraction
-depth per round — O(N^1.5 · K) FLOPs that dominate the tick at the
-1M-peer BASELINE config.  This kernel makes the permutation nearly
-free and keeps every round VMEM-resident:
+The overlay tick (models/overlay.py) is, per exchange round ``f``:
+permute the payload matrix by ``x[i ^ m_f]`` and fold the incoming
+view into the receiver's table; then consume JOINREP/JOINREQ, extract
+the winners, and run staleness detection.  The XLA formulation pays
+two HIGHEST-precision f32 permutation matmuls of O(sqrt(N))
+contraction depth per round — O(N^1.5 · K) FLOPs that dominate at the
+1M-peer BASELINE config — plus a long chain of (N, K) elementwise ops
+whose intermediates round-trip HBM.  This kernel does the entire
+per-(N, K) phase in one launch:
 
-* grid = row blocks only; each step DMAs all F source blocks (the same
-  payload array bound F times, each with its own scalar-prefetched
-  **block index map** ``i ^ (m_f >> lgB)`` routing the mask's high
-  bits) and merges all F rounds into the accumulators in registers;
-* the mask's low bits are a **butterfly network in VMEM**: for each
-  set bit ``j`` of ``m % B``, rows swap with their ``r ^ 2^j`` partner
-  — a static rotate + select, predicated with ``pl.when`` so unset
-  bits cost nothing, exact integer moves (no bf16-truncation hazard);
-* entries travel packed — id word + ``_pack_th``-packed (ts, hb) word,
-  2K+1+F lanes per row — so the butterfly moves half the data of a
-  separate-planes layout, and the packed word IS the merge tiebreak
-  payload;
+* the high bits of ``i ^ m`` are folded into the grid's **block index
+  map** (block ``i`` DMAs source block ``i ^ (m >> lgB)`` — the mask is
+  a scalar-prefetch argument, so the DMA address is known before the
+  body runs);
+* the low bits are a **butterfly network in VMEM**: for each set bit
+  ``j`` of ``m % B``, rows swap with their ``r ^ 2^j`` partner — a
+  static rotate + select, predicated with ``pl.when`` so unset bits
+  cost nothing, exact integer moves (no bf16-truncation hazard);
 * because tables are slotted by the global epoch map (models/overlay.py
-  design), the merge itself is a **lane-aligned lexicographic
+  design), each round's merge is a **lane-aligned lexicographic
   (key, payload) max** on (B, K) — no slot-match product — plus a
-  one-hot merge of the partner's self-entry.
+  one-hot merge of the partner's self-entry;
+* accumulator init (the receiver's own keys), receiver ``proc``
+  gating, the JOINREP broadcast merge, the JOINREQ row-0 aggregate
+  merge, winner extraction, TREMOVE staleness detection, and the
+  per-row metric counts all run in the same launch.
 
-Per tick the kernel reads the payload F times and the accumulators
-once; there are no matmuls at all.
+Everything the kernel needs beyond the (N, K) tables rides in lane
+padding or tiny replicated blocks: a (N, K) int32 array is stored
+lane-padded to 128 on TPU anyway, so the aux columns (own_hb, the
+packed proc/ops/jrep bits, the F send flags) extend the ids plane to
+(N, K+2+F) at zero extra HBM, and the per-row counters ride lanes
+[K, K+6) of the ts output plane.  Per tick the kernel reads each
+table plane 1+F times and writes the three result planes once; there
+are no matmuls at all.
 
 Semantics are bit-identical to the XLA phases in models/overlay.py
-(same ``_pack_key``/``_pack_th``/``_slot_of`` contract, same candidate
-validity; lexicographic max is order-free, so fusing the rounds cannot
-change the winner).  Differentially tested in
-tests/test_overlay_pallas.py; the receiver-side ``proc`` gate and the
-JOINREQ/JOINREP merges stay outside (models/overlay.py applies them —
-the merge is commutative, so ordering is free).
+(same ``_pack_key``/``_pack_th``/``_slot_of``/schedule contract; the
+lexicographic max is order-free, so fusing the phases cannot change
+any winner).  Differentially tested in tests/test_overlay_pallas.py.
 
 Mosaic workarounds (observed on v5e): ``_pack_key`` must use the
 masked single-shift tie form — the ``(h >> 24) << 21`` shift pair
@@ -49,8 +54,14 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+#: per-row metric counters packed into the ts output's spare lanes
+#: [K, K+N_COUNTERS): recv, removals, false_removals, victim_slots,
+#: adds, view_slots
+N_COUNTERS = 6
 
 
 def _roll_rows(x, shift: int):
@@ -62,20 +73,36 @@ def _roll_rows(x, shift: int):
     return jnp.concatenate([x[-s:], x[:-s]], axis=0)
 
 
-def _kernel(b: int, c: int, k: int, f_rounds: int, t_remove: int,
-            # scalar prefetch: [t, seed, m_0 .. m_{F-1}]
+def _kernel(b: int, w_cols: int, k: int, f_rounds: int, t_remove: int,
+            churn_lo: int, churn_span: int, never: int,
+            # scalar prefetch: [t, seed, victim_lo, victim_hi,
+            #   fail_tick, rejoin_after, churn_thr, churn_after,
+            #   m_0 .. m_{F-1}]
             sp_ref,
-            # inputs: the payload bound once per round + accumulator init
+            # inputs
             *refs):
-    from ...models.overlay import (SLOT_EPOCH, _pack_key, _pack_key_direct,
-                                   _pack_th, _slot_of)
+    from ...config import INTRODUCER
+    from ...models.overlay import (SLOT_EPOCH, _SALT_CHURN,
+                                   _SALT_CHURN_TICK, _pack_key,
+                                   _pack_key_direct, _pack_th, _slot_of)
+    from ...utils.hash32 import mix32
 
-    prefs = refs[:f_rounds]
-    curkey_ref, curp_ref, kmax_ref, pacc_ref, w_ref = refs[f_rounds:]
+    ia_id = refs[0]                     # (B, W) identity idsaux
+    pw_id = refs[1]                     # (B, K) identity packed (ts, hb)
+    ia_x = refs[2:2 + f_rounds]         # per-round XOR-mapped idsaux
+    pw_x = refs[2 + f_rounds:2 + 2 * f_rounds]
+    intro_ref = refs[2 + 2 * f_rounds]  # (8, K) replicated small input
+    ids_out, hb_out, tsc_out, wa_scr, wp_scr = refs[3 + 2 * f_rounds:]
 
     i_blk = pl.program_id(0)
     t = sp_ref[0]
     seed = sp_ref[1].astype(jnp.uint32)
+    victim_lo = sp_ref[2]
+    victim_hi = sp_ref[3]
+    fail_tick = sp_ref[4]
+    rejoin_after = sp_ref[5]
+    churn_thr = sp_ref[6].astype(jnp.uint32)
+    churn_after = sp_ref[7]
 
     rbits = jax.lax.broadcasted_iota(jnp.int32, (b, 1), 0)
     rows = i_blk * b + rbits                       # (B, 1) global rows
@@ -84,130 +111,232 @@ def _kernel(b: int, c: int, k: int, f_rounds: int, t_remove: int,
     lgb = b.bit_length() - 1
     slot_ep = (t // SLOT_EPOCH).astype(jnp.uint32)
 
-    kmax = curkey_ref[:]
-    pacc = curp_ref[:]
+    # ---- own state + accumulator init ------------------------------
+    my = ia_id[:]
+    my_ids = my[:, :k]
+    bits = my[:, k + 1:k + 2]
+    proc_r = (bits & 1) > 0
+    ops_r = (bits & 2) > 0
+    jrep_r = (bits & 4) > 0
+    my_p = jnp.where(my_ids >= 0, pw_id[:], 0)
+    my_ts = (my_p >> 12) - 1
+    kmax = jnp.where(my_ids >= 0,
+                     _pack_key(seed, t, rows_u, my_ids, my_ts),
+                     jnp.uint32(0))
+    pacc = my_p
     recv = jnp.zeros((b, 1), jnp.int32)
+
+    def lex(kmax, pacc, key_c, p_c):
+        better = (key_c > kmax) | ((key_c == kmax) & (p_c > pacc))
+        return (jnp.where(better, key_c, kmax),
+                jnp.where(better, p_c, pacc))
+
+    # ---- F exchange rounds -----------------------------------------
     for fi in range(f_rounds):
-        m = sp_ref[2 + fi]
-        # ---- butterfly: the XOR permutation's low bits, predicated
-        # per mask bit (unset bits cost nothing) ---------------------
-        w_ref[:] = prefs[fi][:]
+        m = sp_ref[8 + fi]
+        # butterfly the mask's low bits, predicated per bit
+        wa_scr[:] = ia_x[fi][:]
+        wp_scr[:] = pw_x[fi][:]
         for j in range(lgb):
             s = 1 << j
 
             @pl.when(((m >> j) & 1) == 1)
             def _swap(s=s, j=j):
-                cur = w_ref[:]
-                w_ref[:] = jnp.where(((rbits >> j) & 1) == 0,
-                                     _roll_rows(cur, -s), _roll_rows(cur, s))
-        w = w_ref[:]
+                sel = ((rbits >> j) & 1) == 0
+                cur_a = wa_scr[:]
+                wa_scr[:] = jnp.where(sel, _roll_rows(cur_a, -s),
+                                      _roll_rows(cur_a, s))
+                cur_p = wp_scr[:]
+                wp_scr[:] = jnp.where(sel, _roll_rows(cur_p, -s),
+                                      _roll_rows(cur_p, s))
+        wa = wa_scr[:]
+        wp = wp_scr[:]
 
-        # ---- lane-aligned view merge ------------------------------
-        flag = w[:, 2 * k + 1 + fi:2 * k + 2 + fi] > 0   # (B, 1)
-        in_ids = w[:, :k]
-        in_p = w[:, k:2 * k]
+        flag = wa[:, k + 2 + fi:k + 3 + fi] > 0          # (B, 1)
+        ok = flag & proc_r
+        in_ids = wa[:, :k]
+        in_p = wp
         in_ts = (in_p >> 12) - 1
-        valid = flag & (in_ids >= 0) & (t - in_ts < t_remove) \
+        valid = ok & (in_ids >= 0) & (t - in_ts < t_remove) \
             & (in_ids != rows)
         key = jnp.where(valid, _pack_key(seed, t, rows_u, in_ids, in_ts),
                         jnp.uint32(0))
-        p = jnp.where(valid, in_p, 0)
-        better = (key > kmax) | ((key == kmax) & (p > pacc))
-        kmax = jnp.where(better, key, kmax)
-        pacc = jnp.where(better, p, pacc)
+        kmax, pacc = lex(kmax, pacc, key, jnp.where(valid, in_p, 0))
 
-        # ---- the partner's self-entry (one-hot; age exactly 1) ----
-        if t_remove > 1:
+        if t_remove > 1:                 # partner self-entry (age 1)
             partner = rows ^ m
-            psl = _slot_of(seed, slot_ep, partner, k)           # (B, 1)
+            psl = _slot_of(seed, slot_ep, partner, k)
             e_ts = jnp.zeros_like(partner) + (t - 1)
-            pkey = jnp.where(flag, _pack_key_direct(t, partner, e_ts),
+            pkey = jnp.where(ok, _pack_key_direct(t, partner, e_ts),
                              jnp.uint32(0))
-            pp = jnp.where(flag, _pack_th(e_ts, w[:, 2 * k:2 * k + 1]), 0)
+            pp = jnp.where(ok, _pack_th(e_ts, wa[:, k:k + 1]), 0)
             match = psl == kk
-            ck = jnp.where(match, pkey, jnp.uint32(0))
-            cp = jnp.where(match, pp, 0)
-            better = (ck > kmax) | ((ck == kmax) & (cp > pacc))
-            kmax = jnp.where(better, ck, kmax)
-            pacc = jnp.where(better, cp, pacc)
+            kmax, pacc = lex(kmax, pacc,
+                             jnp.where(match, pkey, jnp.uint32(0)),
+                             jnp.where(match, pp, 0))
+        recv = recv + ok.astype(jnp.int32)
 
-        recv = recv + flag.astype(jnp.int32)
+    # ---- JOINREP: the introducer's broadcast view ------------------
+    bc_ids = intro_ref[0:1, :]                       # (1, K)
+    bc_p = intro_ref[1:2, :]
+    bc_ts = (bc_p >> 12) - 1
+    j_valid = jrep_r & (bc_ids >= 0) & (t - bc_ts < t_remove) \
+        & (bc_ids != rows)
+    jkey = jnp.where(j_valid, _pack_key(seed, t, rows_u, bc_ids, bc_ts),
+                     jnp.uint32(0))
+    kmax, pacc = lex(kmax, pacc, jkey, jnp.where(j_valid, bc_p, 0))
+    if t_remove > 1:                     # the introducer's self-entry
+        intro_vec = jnp.zeros_like(rows) + INTRODUCER
+        islot = _slot_of(seed, slot_ep, intro_vec, k)
+        e_ts = jnp.zeros_like(rows) + (t - 1)
+        iok = jrep_r & (rows != INTRODUCER)
+        ikey = jnp.where(iok, _pack_key_direct(t, intro_vec, e_ts),
+                         jnp.uint32(0))
+        ip = jnp.where(iok, _pack_th(e_ts, intro_ref[2:3, 0:1]), 0)
+        imatch = islot == kk
+        kmax, pacc = lex(kmax, pacc,
+                         jnp.where(imatch, ikey, jnp.uint32(0)),
+                         jnp.where(imatch, ip, 0))
 
-    kmax_ref[:] = kmax
-    # the pacc output is (B, 2K) — lanes [0, K) carry the payload
-    # accumulator and lane K the per-row recv count.  A (N, K) i32
-    # array is lane-padded to 128 in TPU tiling anyway, so the widened
-    # output costs no extra HBM and saves a separate (N, 128) buffer.
-    lane0 = jax.lax.broadcasted_iota(jnp.int32, (b, k), 1) == 0
-    pacc_ref[:] = jnp.concatenate([pacc, jnp.where(lane0, recv, 0)], axis=1)
+    # ---- JOINREQ aggregates into the introducer's row --------------
+    is_r0 = rows == INTRODUCER
+    q_kf = intro_ref[3:4, :].astype(jnp.uint32)
+    q_pf = intro_ref[4:5, :]
+    kmax, pacc = lex(kmax, pacc,
+                     jnp.where(is_r0, q_kf, jnp.uint32(0)),
+                     jnp.where(is_r0, q_pf, 0))
+
+    # ---- winner extraction + staleness detection -------------------
+    id_mask = jnp.uint32((1 << 21) - 1)              # ID_MASK
+    ids1 = jnp.where(kmax > 0, (kmax & id_mask).astype(jnp.int32) - 1, -1)
+    ts1 = jnp.where(kmax > 0, (pacc >> 12) - 1, 0)
+    hb1 = jnp.where(kmax > 0, (pacc & 0xFFF) - 1, 0)
+    stale = (ids1 >= 0) & (t - ts1 >= t_remove) & ops_r
+    ids2 = jnp.where(stale, -1, ids1)
+    hb2 = jnp.where(stale, 0, hb1)
+    ts2 = jnp.where(stale, 0, ts1)
+
+    # ---- subject fail/rejoin (closed-form schedule, in-kernel) -----
+    subj = jnp.clip(ids1, 0)
+    subj_u = subj.astype(jnp.uint32)
+    churned = (mix32(seed, subj_u, np.uint32(_SALT_CHURN)) < churn_thr) \
+        & (subj != INTRODUCER)
+    churn_fail = churn_lo + (mix32(seed, subj_u, np.uint32(_SALT_CHURN_TICK))
+                             % np.uint32(churn_span)).astype(jnp.int32)
+    scripted = jnp.where((subj >= victim_lo) & (subj < victim_hi),
+                         fail_tick, never)
+    fail = jnp.where(churn_thr > 0,
+                     jnp.where(churned, churn_fail, never), scripted)
+    after = jnp.where(churn_thr > 0, churn_after, rejoin_after)
+    rejoin = jnp.where((fail != never) & (after != never), fail + after,
+                       never)
+    subj_failed = (t > fail) & (t <= rejoin)
+
+    # ---- outputs: result planes + per-row counters -----------------
+    ids_out[:] = ids2
+    hb_out[:] = hb2
+    ctr = jnp.concatenate([
+        recv,
+        stale.sum(1, keepdims=True).astype(jnp.int32),
+        (stale & ~subj_failed).sum(1, keepdims=True).astype(jnp.int32),
+        ((ids2 >= 0) & subj_failed & ~stale).sum(1, keepdims=True)
+        .astype(jnp.int32),
+        ((ids1 != my_ids) & (ids1 >= 0)).sum(1, keepdims=True)
+        .astype(jnp.int32),
+        (ids2 >= 0).sum(1, keepdims=True).astype(jnp.int32),
+    ], axis=1)                                        # (B, N_COUNTERS)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (b, k), 1)
+    ctr_padded = jnp.concatenate(
+        [ctr, jnp.zeros((b, k - N_COUNTERS), jnp.int32)], axis=1)
+    tsc_out[:] = jnp.concatenate([ts2, ctr_padded], axis=1)
+    del lane
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("k", "t_remove", "block_rows",
+                   static_argnames=("k", "t_remove", "churn_lo",
+                                    "churn_span", "block_rows",
                                     "interpret"))
-def fused_exchange_merge(payload, cur_key, cur_p, masks, t, seed, *,
-                         k: int, t_remove: int,
-                         block_rows: int = 512,
-                         interpret: bool | None = None):
-    """All F exchange rounds' permute+merge in one Pallas launch.
+def fused_overlay_tick(idsaux, pw, intro, masks, scalars, *,
+                       k: int, t_remove: int, churn_lo: int,
+                       churn_span: int, block_rows: int = 512,
+                       interpret: bool | None = None):
+    """The overlay tick's whole (N, K) phase in one Pallas launch.
 
     Args:
-      payload: i32[N, 2K+1+F] — per sender row: the K-slot view's ids,
-        the packed (ts, hb) words (``_pack_th``), own_hb, then the F
-        per-round send flags (0/1).
-      cur_key/cur_p: u32/i32[N, K] — accumulators' initial value (the
-        receiver's current table keys, models/overlay.py).
-      masks: i32[F] — this tick's XOR masks ``m_f`` (all in [1, N)).
-      t, seed: the clock (i32) and hash seed (u32).
+      idsaux: i32[N, K+2+F] — lanes [0, K) the (post-wipe) view ids,
+        lane K own_hb, lane K+1 the packed proc|ops<<1|jrep<<2 bits,
+        lanes [K+2, K+2+F) the per-round send flags.  Stored
+        lane-padded to 128 on TPU anyway, so the aux lanes are free.
+      pw: i32[N, K] — the packed (ts, hb) payload words (_pack_th; 0
+        for empty slots is fine, ids gate validity).
+      intro: i32[8, K] — row 0 the introducer's ids, row 1 its packed
+        words, row 2 lane 0 its own_hb, row 3 the JOINREQ per-slot key
+        aggregate (uint32 bits), row 4 the matching packed payloads.
+      masks: i32[F] — this tick's XOR masks.
+      scalars: i32[8] — [t, seed, victim_lo, victim_hi, fail_tick,
+        rejoin_after, churn_thr (uint32 bits), churn_after].
+      churn_lo/churn_span: static schedule constants (cfg.total_ticks
+        derived — the run cache is keyed on them).
 
-    Returns ``(keymax u32[N, K], p_acc i32[N, K], recv i32[N])`` with
-    NO receiver-side ``proc`` gating — the caller selects
-    ``where(proc, result, initial)`` (bit-equal because an invalid
-    receiver's accumulator is simply discarded).
+    Returns ``(ids2 i32[N, K], hb2 i32[N, K], ts2 i32[N, K],
+    counters i32[N, N_COUNTERS])`` — counters columns are per-row
+    [recv, removals, false_removals, victim_slots, adds, view_slots].
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    n, c = payload.shape
+    n, w_cols = idsaux.shape
     f_rounds = int(masks.shape[0])
-    assert c == 2 * k + 1 + f_rounds, (c, k, f_rounds)
-    b = min(block_rows, n)
+    assert w_cols == k + 2 + f_rounds, (w_cols, k, f_rounds)
+    assert k >= N_COUNTERS
+    # each of the 1+F bindings of the two table planes double-buffers a
+    # (B, <=128)-lane block in VMEM; at F > 4 a 512-row block exceeds
+    # the 16 MB scoped budget (measured: 16.14M at F=8), so halve it
+    b = min(block_rows if f_rounds <= 4 else block_rows // 2, n)
     assert n % b == 0 and b & (b - 1) == 0 and b >= 8, (n, b)
     nb = n // b
 
     i32 = jnp.int32
-    sp = jnp.concatenate([
-        jnp.asarray([t], i32).reshape(1),
-        seed.astype(i32).reshape(1),
-        masks.astype(i32).reshape(f_rounds)])
+    sp = jnp.concatenate([scalars.astype(i32), masks.astype(i32)])
 
-    row_block = lambda i, sp_ref: (i, 0)
+    row_block_w = pl.BlockSpec((b, w_cols), lambda i, sp_ref: (i, 0),
+                               memory_space=pltpu.VMEM)
+    row_block_k = pl.BlockSpec((b, k), lambda i, sp_ref: (i, 0),
+                               memory_space=pltpu.VMEM)
 
-    def payload_spec(fi):
+    def xor_spec(fi, cols):
         return pl.BlockSpec(
-            (b, c),
-            lambda i, sp_ref, fi=fi: (i ^ (sp_ref[2 + fi] // b), 0),
+            (b, cols),
+            lambda i, sp_ref, fi=fi: (i ^ (sp_ref[8 + fi] // b), 0),
             memory_space=pltpu.VMEM)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(nb,),
-        in_specs=[payload_spec(fi) for fi in range(f_rounds)] + [
-            pl.BlockSpec((b, k), row_block, memory_space=pltpu.VMEM),
-            pl.BlockSpec((b, k), row_block, memory_space=pltpu.VMEM),
-        ],
+        in_specs=[row_block_w, row_block_k]
+        + [xor_spec(fi, w_cols) for fi in range(f_rounds)]
+        + [xor_spec(fi, k) for fi in range(f_rounds)]
+        + [pl.BlockSpec((8, k), lambda i, sp_ref: (0, 0),
+                        memory_space=pltpu.VMEM)],
         out_specs=[
-            pl.BlockSpec((b, k), row_block, memory_space=pltpu.VMEM),
-            pl.BlockSpec((b, 2 * k), row_block, memory_space=pltpu.VMEM),
+            row_block_k,
+            row_block_k,
+            pl.BlockSpec((b, 2 * k), lambda i, sp_ref: (i, 0),
+                         memory_space=pltpu.VMEM),
         ],
-        scratch_shapes=[pltpu.VMEM((b, c), i32)],
+        scratch_shapes=[pltpu.VMEM((b, w_cols), i32),
+                        pltpu.VMEM((b, k), i32)],
     )
-    kmax, pacc_recv = pl.pallas_call(
-        functools.partial(_kernel, b, c, k, f_rounds, t_remove),
+    from ...models.overlay import SLOT_EPOCH  # noqa: F401  (doc pointer)
+    from ...state import NEVER
+    ids2, hb2, tsc = pl.pallas_call(
+        functools.partial(_kernel, b, w_cols, k, f_rounds, t_remove,
+                          churn_lo, churn_span, int(NEVER)),
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((n, k), jnp.uint32),
+            jax.ShapeDtypeStruct((n, k), i32),
+            jax.ShapeDtypeStruct((n, k), i32),
             jax.ShapeDtypeStruct((n, 2 * k), i32),
         ],
         interpret=interpret,
-    )(sp, *([payload] * f_rounds), cur_key, cur_p)
-    return kmax, pacc_recv[:, :k], pacc_recv[:, k]
+    )(sp, idsaux, pw, *([idsaux] * f_rounds), *([pw] * f_rounds), intro)
+    return ids2, hb2, tsc[:, :k], tsc[:, k:k + N_COUNTERS]
