@@ -914,15 +914,29 @@ def make_overlay_run(cfg: SimConfig, length: int | None = None,
     """``lax.scan`` over ``length`` ticks (default: the whole run):
     ``run(state, sched) -> (final, metrics[length])``.  The schedule is
     closed-form in the absolute clock carried in the state, so a
-    shorter scan resumes mid-run bit-identically."""
+    shorter scan resumes mid-run bit-identically.
+
+    With ``use_pallas`` (auto on TPU) and a config inside the
+    megakernel envelope (models/overlay_mega.py), the run executes
+    MEGA_TICKS whole ticks per Pallas launch with state resident in
+    VMEM — bit-identical to the per-tick path, but without the
+    per-launch dispatch floor.  Its one observable difference:
+    per-tick ``live_uncovered`` is the "not tracked" sentinel -1
+    (coverage is still validated on the final state host-side)."""
     length = cfg.total_ticks if length is None else length
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
+    from .overlay_mega import make_mega_run, mega_supported
+    mega = bool(use_pallas) and mega_supported(cfg)
     key = (cfg.n, cfg.t_remove, length, resolved_dims(cfg), use_pallas,
-           cfg.topology, cfg.total_ticks,
+           cfg.topology, cfg.total_ticks, mega,
            cfg.churn_rate > 0 or cfg.rejoin_after is not None)
     if key in _OVERLAY_RUN_CACHE:
         return _OVERLAY_RUN_CACHE[key]
+    if mega:
+        run = make_mega_run(cfg, length)
+        _OVERLAY_RUN_CACHE[key] = run
+        return run
     tick = make_overlay_tick(cfg, use_pallas=use_pallas)
 
     @jax.jit

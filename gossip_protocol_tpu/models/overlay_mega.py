@@ -1,0 +1,209 @@
+"""Host harness for the multi-tick overlay megakernel.
+
+Packs the :class:`~.overlay.OverlayState` pytree plus the
+loop-invariant schedule columns into the megakernel's single
+(N, 2K+16) VMEM plane, runs ``lax.scan`` over whole-SLOT_EPOCH
+launches (ops/pallas/overlay_mega.py), and unpacks the result into the
+same ``(final_state, OverlayMetrics[T])`` contract as
+:func:`~.overlay.make_overlay_run` — the megakernel is a drop-in
+scheduling optimization, bit-identical to the XLA tick
+(tests/test_overlay_mega.py).
+
+Why it exists: the per-tick formulation pays a fixed ~300-400 us
+Pallas-launch plus ~500 us XLA-dispatch floor per tick, which caps the
+simulator at ~1.1k ticks/s at N=4096 regardless of how little work a
+tick does (VERDICT round-2 "2-3 ms/tick floor").  Running
+``MEGA_TICKS`` ticks per launch amortizes the whole floor; see
+ops/pallas/overlay_mega.py for the in-kernel design.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import INTRODUCER, SimConfig
+from ..ops.pallas.overlay_mega import (AUX_LANES, MEGA_TICKS, MET_ADDS,
+                                       MET_FALSE_REMOVALS, MET_IN_GROUP,
+                                       MET_RECV, MET_REMOVALS, MET_SENT,
+                                       MET_VICTIM, MET_VIEW,
+                                       mega_overlay_ticks)
+from ..utils.hash32 import mix32
+from .overlay import (_SALT_DEGREE, OverlayMetrics, OverlaySchedule,
+                      OverlayState, _pack_th, exchange_mask, resolved_dims)
+
+#: VMEM budget bound: three (N, <=128-lane) planes plus merge
+#: temporaries must fit the ~16 MB scoped budget
+MEGA_N_LIMIT = 8192
+
+
+def mega_supported(cfg: SimConfig) -> bool:
+    """Whether the single-launch multi-tick kernel covers this config.
+
+    ``f <= 7``: at exactly 8 exchange rounds the interpret-mode
+    executable hits a pathological XLA:CPU slowdown (measured 355 s
+    per tick vs 0.01 s at 7 rounds, same shapes).  The only F=8
+    config is the power-law hub-degree cap, whose BASELINE shape
+    (1M peers) is outside the megakernel envelope regardless; capped
+    power-law runs (cfg.fanout <= 7) still take the mega path."""
+    n = cfg.n
+    k, f = resolved_dims(cfg)
+    return (cfg.model == "overlay" and n & (n - 1) == 0 and 8 <= n
+            and n <= MEGA_N_LIMIT and 2 * k + AUX_LANES <= 128 and f <= 7
+            # the packed (ts+1)<<12 | hb+1 payload word caps runs at
+            # 4094 ticks (make_overlay_tick asserts the same bound)
+            and cfg.total_ticks <= 4094)
+
+
+def _pack_state(cfg: SimConfig, state: OverlayState,
+                sched: OverlaySchedule):
+    """OverlayState + schedule columns -> the (N, 2K+16) plane."""
+    n = cfg.n
+    k, f = resolved_dims(cfg)
+    i32 = jnp.int32
+    rows = jnp.arange(n, dtype=i32)
+    pw = jnp.where(state.ids >= 0, _pack_th(state.ts, state.hb), 0)
+    du = mix32(sched.seed, rows.astype(jnp.uint32), np.uint32(_SALT_DEGREE))
+    deg = 1 + (du[:, None] < sched.deg_thr[None, :]).sum(1).astype(i32)
+    cols = [
+        state.ids, pw,
+        state.in_group.astype(i32)[:, None],
+        state.own_hb[:, None],
+        state.joinreq.astype(i32)[:, None],
+        state.joinrep.astype(i32)[:, None],
+        state.send_flags.astype(i32),
+        jnp.zeros((n, 8 - f), i32),
+        sched.start_of(rows)[:, None],
+        sched.fail_of(rows)[:, None],
+        sched.rejoin_of(rows)[:, None],
+        deg[:, None],
+    ]
+    return jnp.concatenate(cols, axis=1)
+
+
+def _unpack_state(cfg: SimConfig, plane, tick) -> OverlayState:
+    n = cfg.n
+    k, f = resolved_dims(cfg)
+    a = 2 * k
+    ids = plane[:, 0:k]
+    pw = plane[:, k:2 * k]
+    occ = ids >= 0
+    return OverlayState(
+        tick=tick.astype(jnp.int32),
+        ids=ids,
+        hb=jnp.where(occ, (pw & 0xFFF) - 1, 0),
+        ts=jnp.where(occ, (pw >> 12) - 1, 0),
+        in_group=plane[:, a + 0] > 0,
+        own_hb=plane[:, a + 1],
+        send_flags=plane[:, a + 4:a + 4 + f] > 0,
+        joinreq=plane[:, a + 2] > 0,
+        joinrep=plane[:, a + 3] > 0,
+    )
+
+
+def _sp_vector(cfg: SimConfig, sched: OverlaySchedule, t0, s_ticks: int,
+               n: int, f: int):
+    i32 = jnp.int32
+    intro = jnp.int32(INTRODUCER)
+    scalars = jnp.stack([
+        t0.astype(i32) if hasattr(t0, "astype") else jnp.int32(t0),
+        sched.seed.astype(i32), sched.victim_lo, sched.victim_hi,
+        sched.fail_tick, sched.rejoin_after,
+        sched.churn_thr.astype(i32), sched.churn_after,
+        sched.drop_on.astype(i32), sched.drop_open, sched.drop_close,
+        sched.drop_thr.astype(i32),
+        sched.fail_of(intro), sched.rejoin_of(intro),
+    ])
+    ts = t0 + jnp.arange(s_ticks, dtype=i32)
+    masks = jnp.stack([exchange_mask(sched.seed, ts - 1, fi, n)
+                       for fi in range(f)], axis=1)       # (S, F)
+    return jnp.concatenate([scalars, masks.reshape(-1)])
+
+
+def make_mega_run(cfg: SimConfig, length: int):
+    """``run(state, sched) -> (final, OverlayMetrics[length])`` via
+    whole-SLOT_EPOCH megakernel launches (same contract as
+    :func:`~.overlay.make_overlay_run`).
+
+    On TPU the launches run inside one jitted ``lax.scan`` (this
+    image's relay costs ~100 ms per eager dispatch).  On other
+    backends each launch dispatches eagerly: inlining the
+    interpret-mode kernel into an outer jitted scan makes the XLA:CPU
+    compile blow up superlinearly (measured: minutes at F=8), while
+    the standalone kernel compiles in seconds — and the launch
+    sequence is identical either way."""
+    assert mega_supported(cfg), "config outside the megakernel envelope"
+    n = cfg.n
+    k, f = resolved_dims(cfg)
+    n_chunks, rem = divmod(length, MEGA_TICKS)
+    kern_kw = dict(n=n, k=k, f_rounds=f, t_remove=cfg.t_remove,
+                   churn_lo=cfg.total_ticks // 4,
+                   churn_span=max(cfg.total_ticks // 2, 1),
+                   can_rejoin=cfg.churn_rate > 0
+                   or cfg.rejoin_after is not None,
+                   powerlaw=cfg.topology == "powerlaw")
+
+    def _metrics(met):
+        return OverlayMetrics(
+            in_group=met[:, MET_IN_GROUP],
+            view_slots=met[:, MET_VIEW],
+            adds=met[:, MET_ADDS],
+            removals=met[:, MET_REMOVALS],
+            false_removals=met[:, MET_FALSE_REMOVALS],
+            victim_slots=met[:, MET_VICTIM],
+            live_uncovered=jnp.full((length,), -1, jnp.int32),
+            sent=met[:, MET_SENT],
+            recv=met[:, MET_RECV],
+        )
+
+    def launch(plane, t, sched, s_ticks):
+        """One megakernel launch of ``s_ticks`` ticks at clock ``t``."""
+        sp = _sp_vector(cfg, sched, t, s_ticks, n, f)
+        plane, met = mega_overlay_ticks(plane, sp, s_ticks=s_ticks,
+                                        **kern_kw)
+        return plane, t + s_ticks, met
+
+    def assemble(cfg_plane_t, met_parts):
+        plane, t = cfg_plane_t
+        met = jnp.concatenate(met_parts, axis=0) if met_parts \
+            else jnp.zeros((0, 128), jnp.int32)
+        return _unpack_state(cfg, plane, t), _metrics(met)
+
+    def run_body(state: OverlayState, sched: OverlaySchedule):
+        plane = _pack_state(cfg, state, sched)
+        t = state.tick
+        met_parts = []
+        if n_chunks:
+            def step(carry, _):
+                plane, t, met = launch(carry[0], carry[1], sched,
+                                       MEGA_TICKS)
+                return (plane, t), met
+            (plane, t), met_main = jax.lax.scan(
+                step, (plane, t), None, length=n_chunks)
+            met_parts.append(met_main.reshape(n_chunks * MEGA_TICKS, 128))
+        if rem:
+            plane, t, met_rem = launch(plane, t, sched, rem)
+            met_parts.append(met_rem)
+        return assemble((plane, t), met_parts)
+
+    if jax.default_backend() == "tpu":
+        # the megakernel's whole-state-resident buffers + Mosaic stack
+        # exceed the default 16 MB scoped-vmem window (measured ~34 MB
+        # at N=4096, F=3); v5e has 128 MB of physical VMEM
+        return jax.jit(run_body, compiler_options={
+            "xla_tpu_scoped_vmem_limit_kib": "98304"})
+
+    def run_eager(state: OverlayState, sched: OverlaySchedule):
+        plane = _pack_state(cfg, state, sched)
+        t = state.tick
+        met_parts = []
+        for _ in range(n_chunks):
+            plane, t, met = launch(plane, t, sched, MEGA_TICKS)
+            met_parts.append(met)
+        if rem:
+            plane, t, met = launch(plane, t, sched, rem)
+            met_parts.append(met)
+        return assemble((plane, t), met_parts)
+
+    return run_eager

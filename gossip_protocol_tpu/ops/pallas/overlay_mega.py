@@ -1,0 +1,523 @@
+"""Multi-tick Pallas megakernel: S overlay ticks per launch, state in VMEM.
+
+Round-2 profiling showed the overlay tick's wall-clock at N <= 16k is
+dominated by *fixed* per-launch cost, not work: one Pallas launch costs
+~300-400 us regardless of size (measured: N=512 single-block kernel
+316 us, N=4096 430 us), and the non-kernel XLA vector phases add another
+~500 us of per-op dispatch floor.  At N=4096 that caps the simulator at
+~1,100 ticks/s while the chip is ~99% idle — exactly the gap between
+the per-tick hot loop the reference runs on a CPU
+(/root/reference/Application.cpp:99-104) and BASELINE.md's >=10k
+ticks/s north star.
+
+This kernel removes the floor by running ``MEGA_TICKS`` whole protocol
+ticks per launch with the entire world state resident in VMEM:
+
+* **One state plane.**  ids, the packed (ts, hb) payload words, and all
+  per-peer vectors (in_group, own_hb, joinreq, joinrep, the F send
+  flags) plus the loop-invariant schedule columns (start/fail/rejoin
+  ticks, power-law out-degree) share a single (N, 2K+16) i32 plane —
+  2K+16 <= 128 lanes, so the whole state is one native VMEM tile wide
+  and the per-tick HBM round-trip disappears entirely.
+* **Everything in-kernel.**  Each tick runs the full pipeline of
+  models/overlay.py: churn wipe, join/start decisions, the JOINREQ
+  slot aggregation at the introducer, F XOR exchange rounds (full
+  in-VMEM butterfly — no grid, so every mask bit is a roll+select),
+  the lane-aligned lexicographic merges, JOINREP/JOINREQ handling,
+  winner extraction, TREMOVE staleness detection, the SLOT_EPOCH
+  re-slot pass, drop-masked send flags, and the per-tick metric
+  reductions (stored one row per tick).
+* **Bounded live set.**  Mosaic keeps every live (N, lanes) value in
+  VMEM, so a tick written as one flat dataflow spills ~60 whole planes
+  (measured 126 MB of allocator spill slots at N=4096 — over the
+  128 MB v5e VMEM).  The tick is therefore phased: the butterflies
+  write F whole-plane scratches, and all per-row logic (decisions,
+  merges, joins, extraction, detection, metrics) runs in a fori loop
+  over row CHUNKS whose live values are (B, lanes)-sized.
+* **Same bits.**  All randomness is the same counter-hash streams
+  (utils/hash32.mix32) evaluated in-kernel; the per-launch XOR masks
+  ride the scalar-prefetch vector.  The trajectory is bit-identical to
+  the XLA path (differentially tested in tests/test_overlay_mega.py),
+  so the megakernel is a pure scheduling optimization.
+
+Scope: single-device, power-of-two N with 2*K+16 <= 128 and
+N <= MEGA_N_LIMIT.  Larger N keeps the per-tick fused kernel
+(overlay_exchange.py); the sharded mesh path keeps the XLA
+formulation.
+
+The per-tick metric ``live_uncovered`` needs a cross-peer histogram
+the kernel does not compute; the megakernel path reports -1 (the
+"not tracked" sentinel already used above COVERAGE_N_LIMIT) and
+final-state coverage is still validated host-side
+(models/overlay.py OverlayResult.final_coverage).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: protocol ticks per launch (the launch-overhead amortization factor).
+#: One slot epoch per launch keeps at most one re-slot pass per chunk.
+MEGA_TICKS = 16
+
+#: row-chunk height of the per-row phase (bounds the live-value set)
+CHUNK_ROWS = 1024
+
+#: aux lane offsets, relative to lane 2K
+_IN_GROUP = 0
+_OWN_HB = 1
+_JOINREQ = 2
+_JOINREP = 3
+_SF = 4          # send flags, lanes [_SF, _SF + F), F <= 8
+_START = 12
+_FAIL = 13
+_REJOIN = 14
+_DEG = 15
+AUX_LANES = 16
+
+#: scalar-prefetch layout (masks follow, F per tick)
+_SP_T0 = 0
+_SP_SEED = 1
+_SP_VLO = 2
+_SP_VHI = 3
+_SP_FTICK = 4
+_SP_RAFTER = 5
+_SP_CTHR = 6
+_SP_CAFTER = 7
+_SP_DROP_ON = 8
+_SP_DROP_OPEN = 9
+_SP_DROP_CLOSE = 10
+_SP_DROP_THR = 11
+_SP_FAIL0 = 12
+_SP_REJOIN0 = 13
+_SP_NSCALARS = 14
+
+#: metric column layout of the (S, 128) output
+MET_IN_GROUP = 0
+MET_VIEW = 1
+MET_ADDS = 2
+MET_REMOVALS = 3
+MET_FALSE_REMOVALS = 4
+MET_VICTIM = 5
+MET_SENT = 6
+MET_RECV = 7
+
+_SIGN = np.uint32(0x80000000)
+
+
+def _roll_rows(x, shift: int):
+    """Static circular roll along sublanes (concat of static slices)."""
+    s = shift % x.shape[0]
+    if s == 0:
+        return x
+    return jnp.concatenate([x[-s:], x[:-s]], axis=0)
+
+
+def _umax0(x):
+    """Column-wise uint32 max over sublanes via the sign-flip trick —
+    Mosaic legalizes signed i32 reductions but not unsigned ones."""
+    s = (x ^ _SIGN).astype(jnp.int32)
+    return (s.max(axis=0, keepdims=True).astype(jnp.uint32)) ^ _SIGN
+
+
+def _sum_all(x):
+    """(N, C) -> (1, 1) i32 full reduction."""
+    return x.astype(jnp.int32).sum(axis=1, keepdims=True) \
+        .sum(axis=0, keepdims=True)
+
+
+def _lex(kmax, pacc, key_c, p_c):
+    """Lexicographic (key, payload) max — associative and commutative."""
+    better = (key_c > kmax) | ((key_c == kmax) & (p_c > pacc))
+    return (jnp.where(better, key_c, kmax),
+            jnp.where(better, p_c, pacc))
+
+
+def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, t_remove: int,
+            churn_lo: int, churn_span: int, never: int, can_rejoin: bool,
+            powerlaw: bool, dbg: tuple,
+            sp_ref, st_in, st_out, met_out, *w_refs):
+    from ...config import INTRODUCER
+    from ...models.overlay import (ID_MASK, SLOT_EPOCH, _SALT_CHURN,
+                                   _SALT_CHURN_TICK, _SALT_GOSSIP_DROP,
+                                   _SALT_JOINREP_DROP, _SALT_JOINREQ_DROP,
+                                   _pack_key, _pack_key_direct, _pack_th,
+                                   _slot_of)
+    from ...utils.hash32 import mix32
+
+    a = 2 * k                                   # aux lane base
+    w = a + AUX_LANES
+    b = min(CHUNK_ROWS, n)                      # row-chunk height
+    n_chunks = n // b
+    seed = sp_ref[_SP_SEED].astype(jnp.uint32)
+    churn_thr = sp_ref[_SP_CTHR].astype(jnp.uint32)
+    drop_thr = sp_ref[_SP_DROP_THR].astype(jnp.uint32)
+    i32 = jnp.int32
+
+    rows_n = jax.lax.broadcasted_iota(i32, (n, 1), 0)
+    rows_b0 = jax.lax.broadcasted_iota(i32, (b, 1), 0)
+    kk_n = jax.lax.broadcasted_iota(i32, (n, k), 1)
+    kk_b = jax.lax.broadcasted_iota(i32, (b, k), 1)
+    fis_b = jax.lax.broadcasted_iota(i32, (b, f_rounds), 1)
+
+    st_out[:] = st_in[:]
+
+    def tick(s, _):
+        t = sp_ref[_SP_T0] + s
+        tu = t.astype(jnp.uint32)
+        slot_ep = (t // SLOT_EPOCH).astype(jnp.uint32)
+
+        # introducer scalars (start_of(INTRODUCER) == 0)
+        fail0 = sp_ref[_SP_FAIL0]
+        rejoin0 = sp_ref[_SP_REJOIN0]
+        failed0 = (t > fail0) & (t <= rejoin0)
+        proc0 = (t > 0) & jnp.logical_not(failed0)
+
+        # ---- phase A0 (whole plane): churn wipe --------------------
+        # (models/overlay.py "churn wipe"); freezes the send-tick
+        # payload — post-wipe tables + own_hb — in the state plane
+        if can_rejoin:
+            st = st_out[:]
+            rejoining_n = t == st[:, a + _REJOIN:a + _REJOIN + 1]
+            keep = ~rejoining_n
+            st_out[:] = jnp.concatenate(
+                [jnp.where(keep, st[:, 0:k], -1),
+                 jnp.where(keep, st[:, k:a], 0),
+                 jnp.where(keep, st[:, a:a + 2], 0),
+                 st[:, a + 2:]], axis=1)
+
+        # ---- phase A1 (whole plane): JOINREQ slot aggregates -------
+        # at the introducer (addMember, MP1Node.cpp:265-280) — the
+        # overlay's dense one-hot max as a sublane reduction
+        st = st_out[:]
+        jreq_n = (st[:, a + _JOINREQ:a + _JOINREQ + 1] > 0) & proc0
+        q_slot = _slot_of(seed, slot_ep, rows_n, k)
+        q_ok = jreq_n & (rows_n != INTRODUCER)
+        q_key = jnp.where(q_ok,
+                          _pack_key_direct(t, rows_n,
+                                           jnp.zeros_like(rows_n) + t),
+                          jnp.uint32(0))
+        q_kf = _umax0(jnp.where(q_slot == kk_n, q_key, jnp.uint32(0)))
+        q_pf = jnp.where(q_kf > 0, _pack_th(t, 1), 0)        # (1, K)
+        jreq_cnt = _sum_all(jreq_n)
+
+        # the introducer's payload row (JOINREP broadcast source) —
+        # snapshotted before any chunk overwrites row 0
+        bc = st_out[INTRODUCER:INTRODUCER + 1, :]            # (1, W)
+
+        # ---- phase A2 (whole plane): F XOR butterflies -------------
+        # Every bit level applies unconditionally (select on the mask
+        # bit) instead of a pl.when per level: measured, a cond per
+        # level makes the interpret-mode XLA:CPU compile blow up
+        # superlinearly (>500 s at 18 conds/tick), while the extra
+        # rolls are VMEM-bandwidth noise on TPU.
+        for fi in range(f_rounds):
+            m = sp_ref[_SP_NSCALARS + s * f_rounds + fi]
+            w_refs[fi][:] = st_out[:]
+            for j in range(0 if 'nofly' in dbg else n.bit_length() - 1):
+                sh = 1 << j
+                mbit = ((m >> j) & 1) == 1
+                sel = ((rows_n >> j) & 1) == 0
+                cur = w_refs[fi][:]
+                swapped = jnp.where(sel, _roll_rows(cur, -sh),
+                                    _roll_rows(cur, sh))
+                w_refs[fi][:] = jnp.where(mbit, swapped, cur)
+
+        # ---- phase B (row chunks): the whole per-row pipeline ------
+        met_out[pl.ds(s, 1), :] = jnp.zeros((1, 128), i32)
+
+        def chunk(c, _):
+            if 'nochunk' in dbg:
+                return ()
+            r0 = c * b
+            rows = rows_b0 + r0
+            rows_u = rows.astype(jnp.uint32)
+            is_intro = rows == INTRODUCER
+            st = st_out[pl.ds(r0, b), :]
+            ids0 = st[:, 0:k]
+            pw0 = st[:, k:a]
+            in_group0 = st[:, a + _IN_GROUP:a + _IN_GROUP + 1] > 0
+            own_hb0 = st[:, a + _OWN_HB:a + _OWN_HB + 1]
+            joinreq_c = st[:, a + _JOINREQ:a + _JOINREQ + 1] > 0
+            joinrep_c = st[:, a + _JOINREP:a + _JOINREP + 1] > 0
+            start = st[:, a + _START:a + _START + 1]
+            fail = st[:, a + _FAIL:a + _FAIL + 1]
+            rejoin = st[:, a + _REJOIN:a + _REJOIN + 1]
+
+            failed = (t > fail) & (t <= rejoin)
+            proc = (t > start) & ~failed
+            rejoining = (t == rejoin) if can_rejoin \
+                else jnp.zeros_like(is_intro)
+
+            # vector decisions (models/overlay.py "vector decisions")
+            jrep = joinrep_c & proc
+            in_group = in_group0 | jrep
+            starting = (t == start) | rejoining
+            in_group = in_group | (starting & is_intro)
+            ops = proc & in_group
+            own_hb = own_hb0 + ops.astype(i32)
+
+            # accumulator init
+            ts0 = (pw0 >> 12) - 1
+            kmax = jnp.where(ids0 >= 0,
+                             _pack_key(seed, t, rows_u, ids0, ts0),
+                             jnp.uint32(0))
+            pacc = pw0
+            recv = jnp.zeros((b, 1), i32)
+
+            # F exchange rounds: lane-aligned lexicographic merges
+            for fi in range(f_rounds):
+                m = sp_ref[_SP_NSCALARS + s * f_rounds + fi]
+                wv = w_refs[fi][pl.ds(r0, b), :]
+                in_ids = wv[:, 0:k]
+                in_p = wv[:, k:a]
+                in_ts = (in_p >> 12) - 1
+                own_p = wv[:, a + _OWN_HB:a + _OWN_HB + 1]
+                flag = wv[:, a + _SF + fi:a + _SF + fi + 1] > 0
+                ok = flag & proc
+                valid = ok & (in_ids >= 0) & (t - in_ts < t_remove) \
+                    & (in_ids != rows)
+                key = jnp.where(valid,
+                                _pack_key(seed, t, rows_u, in_ids, in_ts),
+                                jnp.uint32(0))
+                kmax, pacc = _lex(kmax, pacc, key,
+                                  jnp.where(valid, in_p, 0))
+                if t_remove > 1:         # partner self-entry (age 1)
+                    partner = rows ^ m
+                    psl = _slot_of(seed, slot_ep, partner, k)
+                    e_ts = jnp.zeros_like(partner) + (t - 1)
+                    pkey = jnp.where(ok,
+                                     _pack_key_direct(t, partner, e_ts),
+                                     jnp.uint32(0))
+                    pp = jnp.where(ok, _pack_th(e_ts, own_p), 0)
+                    match = psl == kk_b
+                    kmax, pacc = _lex(kmax, pacc,
+                                      jnp.where(match, pkey, jnp.uint32(0)),
+                                      jnp.where(match, pp, 0))
+                recv = recv + ok.astype(i32)
+
+            # JOINREP: the introducer's broadcast view
+            bc_ids = bc[:, 0:k]
+            bc_p = bc[:, k:a]
+            bc_ts = (bc_p >> 12) - 1
+            j_valid = jrep & (bc_ids >= 0) & (t - bc_ts < t_remove) \
+                & (bc_ids != rows)
+            jkey = jnp.where(j_valid,
+                             _pack_key(seed, t, rows_u, bc_ids, bc_ts),
+                             jnp.uint32(0))
+            kmax, pacc = _lex(kmax, pacc, jkey,
+                              jnp.where(j_valid, bc_p, 0))
+            if t_remove > 1:             # the introducer's self-entry
+                intro_vec = jnp.zeros_like(rows) + INTRODUCER
+                islot = _slot_of(seed, slot_ep, intro_vec, k)
+                e_ts = jnp.zeros_like(rows) + (t - 1)
+                iok = jrep & ~is_intro
+                ikey = jnp.where(iok, _pack_key_direct(t, intro_vec, e_ts),
+                                 jnp.uint32(0))
+                ip = jnp.where(iok,
+                               _pack_th(e_ts,
+                                        bc[:, a + _OWN_HB:a + _OWN_HB + 1]),
+                               0)
+                imatch = islot == kk_b
+                kmax, pacc = _lex(kmax, pacc,
+                                  jnp.where(imatch, ikey, jnp.uint32(0)),
+                                  jnp.where(imatch, ip, 0))
+
+            # JOINREQ aggregates into the introducer's row
+            kmax, pacc = _lex(kmax, pacc,
+                              jnp.where(is_intro, q_kf, jnp.uint32(0)),
+                              jnp.where(is_intro, q_pf, 0))
+
+            # winner extraction + staleness detection
+            ids1 = jnp.where(kmax > 0,
+                             (kmax & jnp.uint32(ID_MASK)).astype(i32) - 1,
+                             -1)
+            ts1 = jnp.where(kmax > 0, (pacc >> 12) - 1, 0)
+            hb1 = jnp.where(kmax > 0, (pacc & 0xFFF) - 1, 0)
+            stale = (ids1 >= 0) & (t - ts1 >= t_remove) & ops
+            ids2 = jnp.where(stale, -1, ids1)
+            pw2 = jnp.where(stale | (ids1 < 0), 0, _pack_th(ts1, hb1))
+
+            # subject fail/rejoin (closed-form schedule, in-kernel)
+            subj = jnp.where(ids1 >= 0, ids1, 0)
+            subj_u = subj.astype(jnp.uint32)
+            churned = (mix32(seed, subj_u, np.uint32(_SALT_CHURN))
+                       < churn_thr) & (subj != INTRODUCER)
+            churn_fail = churn_lo + (
+                mix32(seed, subj_u, np.uint32(_SALT_CHURN_TICK))
+                % np.uint32(churn_span)).astype(i32)
+            scripted = jnp.where(
+                (subj >= sp_ref[_SP_VLO]) & (subj < sp_ref[_SP_VHI]),
+                sp_ref[_SP_FTICK], never)
+            s_fail = jnp.where(churn_thr > 0,
+                               jnp.where(churned, churn_fail, never),
+                               scripted)
+            s_after = jnp.where(churn_thr > 0, sp_ref[_SP_CAFTER],
+                                sp_ref[_SP_RAFTER])
+            s_rejoin = jnp.where((s_fail != never) & (s_after != never),
+                                 s_fail + s_after, never)
+            subj_failed = (t > s_fail) & (t <= s_rejoin)
+
+            # dissemination: next tick's send flags
+            active = (sp_ref[_SP_DROP_ON] > 0) \
+                & (t > sp_ref[_SP_DROP_OPEN]) \
+                & (t <= sp_ref[_SP_DROP_CLOSE])
+            gdrop = mix32(seed, tu, rows_u, fis_b.astype(jnp.uint32),
+                          np.uint32(_SALT_GOSSIP_DROP)) < drop_thr
+            sf_next = ops & ~(active & gdrop)
+            if powerlaw:
+                deg = st[:, a + _DEG:a + _DEG + 1]
+                sf_next = sf_next & (fis_b < deg)
+            joinreq_new = starting & ~is_intro
+            qdrop = mix32(seed, tu, rows_u,
+                          np.uint32(_SALT_JOINREQ_DROP)) < drop_thr
+            pdrop = mix32(seed, tu, rows_u,
+                          np.uint32(_SALT_JOINREP_DROP)) < drop_thr
+            joinreq_sent = joinreq_new & ~(active & qdrop)
+            jreq = joinreq_c & proc0
+            joinrep_sent = jreq & ~(active & pdrop)
+            live_hold = ~proc & ~failed
+            joinreq_next = joinreq_sent \
+                | (joinreq_c & ~proc0 & jnp.logical_not(failed0))
+            joinrep_next = joinrep_sent | (joinrep_c & live_hold)
+
+            # metrics: accumulate into this tick's row
+            deltas = (
+                (MET_IN_GROUP, _sum_all(in_group)),
+                (MET_VIEW, _sum_all(ids2 >= 0)),
+                (MET_ADDS, _sum_all((ids1 != ids0) & (ids1 >= 0))),
+                (MET_REMOVALS, _sum_all(stale)),
+                (MET_FALSE_REMOVALS, _sum_all(stale & ~subj_failed)),
+                (MET_VICTIM,
+                 _sum_all((ids2 >= 0) & subj_failed & ~stale)),
+                (MET_SENT, _sum_all(sf_next) + _sum_all(joinreq_sent)
+                 + _sum_all(joinrep_sent)),
+                (MET_RECV, _sum_all(recv) + _sum_all(jrep)),
+            )
+            for col, d in (() if 'nomet' in dbg else deltas):
+                met_out[pl.ds(s, 1), pl.ds(col, 1)] = \
+                    met_out[pl.ds(s, 1), pl.ds(col, 1)] + d
+
+            # write the end-of-tick chunk
+            sf_i = sf_next.astype(i32)
+            if f_rounds < 8:
+                sf_i = jnp.concatenate(
+                    [sf_i, jnp.zeros((b, 8 - f_rounds), i32)], axis=1)
+            st_out[pl.ds(r0, b), :] = jnp.concatenate(
+                [ids2, pw2, in_group.astype(i32), own_hb,
+                 joinreq_next.astype(i32), joinrep_next.astype(i32),
+                 sf_i, st[:, a + _START:]], axis=1)
+            return ()
+
+        jax.lax.fori_loop(0, n_chunks, chunk, (), unroll=False)
+        # JOINREQs consumed by the introducer count as receives
+        # (jrep receives are accumulated per chunk above)
+        met_out[pl.ds(s, 1), pl.ds(MET_RECV, 1)] = \
+            met_out[pl.ds(s, 1), pl.ds(MET_RECV, 1)] + jreq_cnt
+
+        # ---- phase C (whole plane): SLOT_EPOCH re-roll -------------
+        if 'noreslot' in dbg:
+            return ()
+        @pl.when((t + 1) % SLOT_EPOCH == 0)
+        def _reslot():
+            cur = st_out[:]
+            idsv = cur[:, 0:k]
+            pwv = cur[:, k:a]
+            tsv = (pwv >> 12) - 1
+            next_ep = ((t + 1) // SLOT_EPOCH).astype(jnp.uint32)
+            tgt = _slot_of(seed, next_ep, idsv, k)
+            key = jnp.where(idsv >= 0,
+                            _pack_key(seed, t, rows_n.astype(jnp.uint32),
+                                      idsv, tsv),
+                            jnp.uint32(0))
+
+            # contention resolved by a pairwise lex-max reduction TREE
+            # over the K source slots (lex-max is associative and
+            # commutative).  A sequential K-step chain compiles the
+            # same bits, but XLA:CPU's interpret-mode compile blows up
+            # superlinearly on the K-long dependent chain (measured:
+            # k=16 ~10 s, k=24 >500 s); the tree is log-depth with
+            # O(log K) live (N, K) planes.
+            def cand(j):
+                match = tgt[:, j:j + 1] == kk_n
+                return (jnp.where(match, key[:, j:j + 1], jnp.uint32(0)),
+                        jnp.where(match, pwv[:, j:j + 1], 0))
+
+            def reduce_slots(lo, hi):
+                if hi - lo == 1:
+                    return cand(lo)
+                mid = (lo + hi) // 2
+                ka, pa = reduce_slots(lo, mid)
+                kb, pb = reduce_slots(mid, hi)
+                return _lex(ka, pa, kb, pb)
+
+            kf, pf = reduce_slots(0, k)
+            ids_r = jnp.where(kf > 0,
+                              (kf & jnp.uint32(ID_MASK)).astype(i32) - 1,
+                              -1)
+            pw_r = jnp.where(kf > 0, pf, 0)
+            st_out[:] = jnp.concatenate([ids_r, pw_r, cur[:, a:]], axis=1)
+
+        return ()
+
+    jax.lax.fori_loop(0, s_ticks, tick, (), unroll=False)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n", "k", "f_rounds", "s_ticks", "t_remove",
+                              "churn_lo", "churn_span", "can_rejoin",
+                              "powerlaw", "interpret", "dbg"))
+def mega_overlay_ticks(st, sp, *, n: int, k: int, f_rounds: int,
+                       s_ticks: int, t_remove: int, churn_lo: int,
+                       churn_span: int, can_rejoin: bool, powerlaw: bool,
+                       interpret: bool | None = None, dbg: tuple = ()):
+    """Run ``s_ticks`` whole overlay ticks in one Pallas launch.
+
+    Args:
+      st: i32[N, 2K+16] state plane (see module docstring lane map).
+      sp: i32[_SP_NSCALARS + s_ticks*F] scalars + per-tick XOR masks.
+
+    Returns ``(st', metrics i32[s_ticks, 128])`` — metric columns per
+    the MET_* constants; lanes >= 8 are zero.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    w = st.shape[1]
+    assert w == 2 * k + AUX_LANES and w <= 128, (w, k)
+    assert st.shape[0] == n and n & (n - 1) == 0 and n >= 8
+    assert f_rounds <= 8
+    from ...state import NEVER
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[pl.BlockSpec((n, w), lambda i, sp: (0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=[
+            pl.BlockSpec((n, w), lambda i, sp: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((s_ticks, 128), lambda i, sp: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[pltpu.VMEM((n, w), jnp.int32)
+                        for _ in range(f_rounds)],
+    )
+    st2, met = pl.pallas_call(
+        functools.partial(_kernel, n, k, f_rounds, s_ticks, t_remove,
+                          churn_lo, churn_span, int(NEVER), can_rejoin,
+                          powerlaw, dbg),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((n, w), jnp.int32),
+                   jax.ShapeDtypeStruct((s_ticks, 128), jnp.int32)],
+        # the whole-state-resident design needs more than the default
+        # 16 MB scoped window; v5e has 128 MB of physical VMEM
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(sp, st)
+    return st2, met

@@ -1,0 +1,125 @@
+"""Decompose the per-tick wall-clock floor on the live backend.
+
+Times, through identical lax.scan harnesses:
+  empty    — a trivial carry bump (the scan-step floor itself)
+  kernel   — only the fused Pallas launch per step
+  vectors  — only the non-kernel (N,)/(K,N) vector phases
+  full     — the whole overlay tick
+
+Development tool for the round-3 "break the 2-3 ms/tick floor" work
+(VERDICT.md task 1).  Usage: python scripts/floor_probe.py [N]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from gossip_protocol_tpu.config import SimConfig
+from gossip_protocol_tpu.models.overlay import (init_overlay_state,
+                                                make_overlay_schedule,
+                                                make_overlay_tick,
+                                                resolved_dims)
+
+
+def scan_time(step_fn, carry, reps=3, length=200):
+    @jax.jit
+    def scanned(c):
+        return jax.lax.scan(lambda c, _: (step_fn(c), None), c, None,
+                            length=length)[0]
+
+    variants = [jax.tree.map(lambda x: x + i if x.dtype != bool else x, carry)
+                for i in range(reps + 1)]
+    jax.block_until_ready(scanned(variants[0]))
+    best = float("inf")
+    for i in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(scanned(variants[i + 1]))
+        best = min(best, time.perf_counter() - t0)
+    return best / length
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    print("backend:", jax.default_backend(), flush=True)
+    cfg = SimConfig(max_nnb=n, model="overlay", single_failure=False,
+                    drop_msg=False, seed=0, total_ticks=300,
+                    churn_rate=0.2, rejoin_after=40, step_rate=64.0 / n)
+    k, f = resolved_dims(cfg)
+    print(f"N={n} K={k} F={f}")
+    sched = make_overlay_schedule(cfg)
+    state = init_overlay_state(cfg)
+    length = 200 if n <= (1 << 17) else 25
+
+    # 1. empty scan floor
+    dt = scan_time(lambda c: c + 1, jnp.int32(0), length=length)
+    print(f"empty    step: {dt*1e6:9.1f} us", flush=True)
+
+    # 2. kernel-only scan
+    from gossip_protocol_tpu.ops.pallas.overlay_exchange import (
+        fused_overlay_tick)
+    i32 = jnp.int32
+    idsaux = jnp.zeros((n, k + 2 + f), i32)
+    pw = jnp.zeros((n, k), i32)
+    intro = jnp.zeros((8, k), i32)
+    masks = jnp.arange(1, f + 1, dtype=i32)
+    scalars = jnp.zeros((8,), i32).at[0].set(5)
+
+    def kstep(c):
+        ids2, hb2, ts2, ctr = fused_overlay_tick(
+            c["a"], c["p"], intro, masks, scalars, k=k, t_remove=cfg.t_remove,
+            churn_lo=cfg.total_ticks // 4,
+            churn_span=max(cfg.total_ticks // 2, 1))
+        return {"a": c["a"].at[:, :k].max(ids2), "p": jnp.maximum(c["p"], ts2)}
+
+    dt = scan_time(lambda c: kstep(c), {"a": idsaux, "p": pw}, length=length)
+    print(f"kernel   step: {dt*1e6:9.1f} us", flush=True)
+
+    # 3. full tick (pallas path)
+    tick = make_overlay_tick(cfg, use_pallas=True)
+
+    def fstep(s):
+        return tick(s, sched)[0]
+
+    variants = [state.replace(own_hb=state.own_hb + i) for i in range(4)]
+
+    @jax.jit
+    def scanned(s):
+        return jax.lax.scan(lambda c, _: (tick(c, sched)[0], None), s, None,
+                            length=length)[0]
+
+    np.asarray(jax.block_until_ready(scanned(variants[0])).tick)
+    best = float("inf")
+    for i in range(3):
+        t0 = time.perf_counter()
+        np.asarray(jax.block_until_ready(scanned(variants[i + 1])).tick)
+        best = min(best, time.perf_counter() - t0)
+    dt = best / length
+    print(f"full     tick: {dt*1e6:9.1f} us -> {1/dt:8.0f} ticks/s",
+          flush=True)
+
+    # 4. xla path
+    tick_x = make_overlay_tick(cfg, use_pallas=False)
+
+    @jax.jit
+    def scanned_x(s):
+        return jax.lax.scan(lambda c, _: (tick_x(c, sched)[0], None), s, None,
+                            length=length)[0]
+
+    np.asarray(jax.block_until_ready(scanned_x(variants[0])).tick)
+    best = float("inf")
+    for i in range(3):
+        t0 = time.perf_counter()
+        np.asarray(jax.block_until_ready(scanned_x(variants[i + 1])).tick)
+        best = min(best, time.perf_counter() - t0)
+    dt = best / length
+    print(f"xla      tick: {dt*1e6:9.1f} us -> {1/dt:8.0f} ticks/s",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""Isolate the fused overlay kernel's cost components on TPU.
+
+Times kernel-only scans while varying:
+  * block_rows (grid step count vs butterfly depth),
+  * mask low bits (masks divisible by b skip every butterfly stage via
+    pl.when predication — isolates butterfly cost from DMA/launch).
+
+Development tool (VERDICT round-3 task 1).  Usage:
+  python scripts/kernel_probe.py [N]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+from gossip_protocol_tpu.config import SimConfig
+from gossip_protocol_tpu.models.overlay import resolved_dims
+from gossip_protocol_tpu.ops.pallas.overlay_exchange import fused_overlay_tick
+
+
+def scan_time(step_fn, carry, reps=3, length=200):
+    @jax.jit
+    def scanned(c):
+        return jax.lax.scan(lambda c, _: (step_fn(c), None), c, None,
+                            length=length)[0]
+
+    variants = [jax.tree.map(lambda x: x + i, carry)
+                for i in range(reps + 1)]
+    jax.block_until_ready(scanned(variants[0]))
+    best = float("inf")
+    for i in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(scanned(variants[i + 1]))
+        best = min(best, time.perf_counter() - t0)
+    return best / length
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    cfg = SimConfig(max_nnb=n, model="overlay", single_failure=False,
+                    drop_msg=False, seed=0, total_ticks=300,
+                    churn_rate=0.2, rejoin_after=40, step_rate=64.0 / n)
+    k, f = resolved_dims(cfg)
+    print(f"backend={jax.default_backend()} N={n} K={k} F={f}", flush=True)
+    i32 = jnp.int32
+    idsaux = jnp.zeros((n, k + 2 + f), i32)
+    pw = jnp.zeros((n, k), i32)
+    intro = jnp.zeros((8, k), i32)
+    scalars = jnp.zeros((8,), i32).at[0].set(5)
+    length = 200 if n <= (1 << 16) else 50
+
+    for br in (256, 512, 1024, 2048):
+        if br > n:
+            continue
+        for lowbits in (True, False):
+            b_eff = min(br if f <= 4 else br // 2, n)
+            masks = (jnp.arange(1, f + 1, dtype=i32) * (1 if lowbits else b_eff)) % n
+            masks = jnp.where(masks == 0, b_eff % n, masks)
+
+            def kstep(c, br=br, masks=masks):
+                ids2, hb2, ts2, ctr = fused_overlay_tick(
+                    c["a"], c["p"], intro, masks, scalars, k=k,
+                    t_remove=cfg.t_remove, churn_lo=cfg.total_ticks // 4,
+                    churn_span=max(cfg.total_ticks // 2, 1), block_rows=br)
+                return {"a": c["a"].at[:, :k].max(ids2),
+                        "p": jnp.maximum(c["p"], ts2)}
+
+            dt = scan_time(kstep, {"a": idsaux, "p": pw}, length=length)
+            print(f"block_rows={br:5d} butterfly={'on ' if lowbits else 'off'}"
+                  f" : {dt*1e6:9.1f} us", flush=True)
+
+
+if __name__ == "__main__":
+    main()

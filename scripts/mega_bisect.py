@@ -1,0 +1,34 @@
+"""Runtime bisect of the megakernel's per-tick cost on TPU (dev tool)."""
+import sys, time
+import jax, jax.numpy as jnp
+sys.path.insert(0, ".")
+from gossip_protocol_tpu.ops.pallas.overlay_mega import (mega_overlay_ticks,
+                                                         _SP_NSCALARS)
+
+n, k, f, s = 4096, 48, 3, 16
+w = 2*k+16
+st0 = jnp.zeros((n, w), jnp.int32).at[:, 0:k].set(-1)
+kw = dict(n=n, k=k, f_rounds=f, s_ticks=s, t_remove=20, churn_lo=75,
+          churn_span=150, can_rejoin=True, powerlaw=False)
+reps, chain = 3, 12
+
+for dbg in ((), ('nofly',), ('nochunk',), ('nomet',), ('noreslot',),
+            ('nofly', 'nochunk', 'noreslot')):
+    @jax.jit
+    def many(st, dbg=dbg):
+        def step(c, _):
+            sp = jnp.zeros((_SP_NSCALARS + s*f,), jnp.int32) \
+                .at[_SP_NSCALARS:].set(jnp.arange(s*f) % (n-1) + 1) \
+                .at[0].set(c[1])
+            st2, met = mega_overlay_ticks(c[0], sp, dbg=dbg, **kw)
+            return (st2, c[1] + s), met[:, :1]
+        return jax.lax.scan(step, (st, jnp.int32(16)), None, length=chain)
+    variants = [st0 + i for i in range(reps + 1)]
+    jax.block_until_ready(many(variants[0]))
+    best = float('inf')
+    for i in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(many(variants[i + 1]))
+        best = min(best, time.perf_counter() - t0)
+    per_tick = best / (chain * s)
+    print(f"dbg={dbg}: {per_tick*1e6:8.1f} us/tick", flush=True)

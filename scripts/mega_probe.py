@@ -1,0 +1,56 @@
+"""Time the megakernel run path vs the per-tick paths on the live TPU.
+
+Usage: python scripts/mega_probe.py [N] [ticks]
+"""
+
+import sys
+import time
+
+import jax
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from gossip_protocol_tpu.config import SimConfig
+from gossip_protocol_tpu.models.overlay import (init_overlay_state,
+                                                make_overlay_run,
+                                                make_overlay_schedule,
+                                                resolved_dims)
+
+
+def time_run(run, state, sched, reps=3):
+    variants = [state.replace(own_hb=state.own_hb + i)
+                for i in range(reps + 1)]
+    np.asarray(jax.block_until_ready(run(variants[0], sched)[0]).tick)
+    best = float("inf")
+    for i in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(jax.block_until_ready(run(variants[i + 1], sched)[0]).tick)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    ticks = int(sys.argv[2]) if len(sys.argv) > 2 else 320
+    cfg = SimConfig(max_nnb=n, model="overlay", single_failure=False,
+                    drop_msg=False, seed=0, total_ticks=ticks,
+                    churn_rate=0.2, rejoin_after=40, step_rate=64.0 / n)
+    print(f"backend={jax.default_backend()} N={n} K,F={resolved_dims(cfg)} "
+          f"T={ticks}", flush=True)
+    sched = make_overlay_schedule(cfg)
+    state = init_overlay_state(cfg)
+    from gossip_protocol_tpu.models.overlay_mega import mega_supported
+    print("mega_supported:", mega_supported(cfg), flush=True)
+
+    for label, up in (("mega", True), ("per-tick", False)):
+        if up and not mega_supported(cfg):
+            continue
+        run = make_overlay_run(cfg, ticks, use_pallas=up)
+        dt = time_run(run, state, sched) / ticks
+        print(f"{label:9s}: {dt*1e6:9.1f} us/tick -> {1/dt:8.0f} ticks/s "
+              f"({n/dt/1e6:9.1f}M node-ticks/s)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

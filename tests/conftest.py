@@ -25,6 +25,13 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite compiles dozens of scan/kernel
+# variants (notably the megakernel's per-(k, f, s_ticks) instances);
+# caching them across runs cuts repeat suite time substantially.
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.expanduser("~/.cache/gossip_tpu_jax"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -1,0 +1,115 @@
+"""Differential tests: multi-tick megakernel vs the XLA overlay path.
+
+The megakernel (ops/pallas/overlay_mega.py + models/overlay_mega.py)
+must replay the exact trajectory of the per-tick XLA formulation —
+final state bit-identical, per-tick metrics identical except
+``live_uncovered`` (the megakernel reports the -1 "not tracked"
+sentinel).  On CPU the kernel runs in interpret mode; the same
+contract holds compiled on TPU (exercised by bench.py).
+"""
+
+import numpy as np
+import pytest
+
+from gossip_protocol_tpu.config import SimConfig
+from gossip_protocol_tpu.models.overlay import (init_overlay_state,
+                                                make_overlay_run,
+                                                make_overlay_schedule)
+from gossip_protocol_tpu.models.overlay_mega import (make_mega_run,
+                                                     mega_supported)
+
+STATE_FIELDS = ("tick", "ids", "hb", "ts", "in_group", "own_hb",
+                "send_flags", "joinreq", "joinrep")
+METRIC_FIELDS = ("in_group", "view_slots", "adds", "removals",
+                 "false_removals", "victim_slots", "sent", "recv")
+
+
+def _cfg(scenario, n):
+    if scenario == "ramp_fail":
+        return SimConfig(max_nnb=n, model="overlay", single_failure=True,
+                         drop_msg=False, seed=3, total_ticks=120,
+                         fail_tick=40, step_rate=0.5)
+    if scenario == "drop":
+        return SimConfig(max_nnb=n, model="overlay", single_failure=True,
+                         drop_msg=True, msg_drop_prob=0.3, seed=5,
+                         total_ticks=120, fail_tick=60, step_rate=0.25,
+                         drop_open_tick=10, drop_close_tick=100)
+    if scenario == "churn":
+        return SimConfig(max_nnb=n, model="overlay", single_failure=False,
+                         drop_msg=False, seed=7, total_ticks=200,
+                         churn_rate=0.25, rejoin_after=30,
+                         step_rate=40.0 / n)
+    if scenario == "powerlaw":
+        # fanout capped at 5: the mega path rejects the default F=8
+        # hub cap (see mega_supported), and a capped power-law still
+        # exercises the out-degree gating
+        return SimConfig(max_nnb=n, model="overlay", single_failure=True,
+                         drop_msg=False, seed=9, total_ticks=120,
+                         fail_tick=50, step_rate=0.5, topology="powerlaw",
+                         fanout=5)
+    raise ValueError(scenario)
+
+
+def _compare(cfg, length):
+    sched = make_overlay_schedule(cfg)
+    state = init_overlay_state(cfg)
+    run_x = make_overlay_run(cfg, length, use_pallas=False)
+    run_m = make_mega_run(cfg, length)
+    fx, mx = run_x(state, sched)
+    fm, mm = run_m(state, sched)
+    for name in STATE_FIELDS:
+        a, b = np.asarray(getattr(fx, name)), np.asarray(getattr(fm, name))
+        assert np.array_equal(a, b), f"state field {name} diverged"
+    for name in METRIC_FIELDS:
+        a, b = np.asarray(getattr(mx, name)), np.asarray(getattr(mm, name))
+        assert np.array_equal(a, b), \
+            f"metric {name} diverged at ticks {np.flatnonzero(a != b)[:5]}"
+    assert np.all(np.asarray(mm.live_uncovered) == -1)
+    return fm
+
+
+@pytest.mark.parametrize("scenario,n", [
+    ("ramp_fail", 64),
+    ("drop", 128),
+    ("churn", 64),
+    ("powerlaw", 64),
+])
+def test_megakernel_bitwise_equals_xla(scenario, n):
+    cfg = _cfg(scenario, n)
+    # 44 = 2 full MEGA_TICKS chunks + a 12-tick remainder launch
+    _compare(cfg, 44)
+
+
+def test_megakernel_full_run_with_churn_cycle():
+    """A whole churn run: ramp, churn fails, rejoins, steady state."""
+    cfg = _cfg("churn", 64)
+    final = _compare(cfg, cfg.total_ticks)
+    assert int(np.asarray(final.in_group).sum()) == cfg.n
+
+
+def test_megakernel_resume_bit_identical():
+    """Stopping after 17 ticks and resuming matches one uninterrupted
+    run (the clock lives in the state; chunk boundaries are free)."""
+    cfg = _cfg("ramp_fail", 64)
+    sched = make_overlay_schedule(cfg)
+    state = init_overlay_state(cfg)
+    mid, _ = make_mega_run(cfg, 17)(state, sched)
+    final_split, _ = make_mega_run(cfg, 23)(mid, sched)
+    final_once, _ = make_mega_run(cfg, 40)(state, sched)
+    for name in STATE_FIELDS:
+        assert np.array_equal(np.asarray(getattr(final_split, name)),
+                              np.asarray(getattr(final_once, name))), name
+
+
+def test_mega_supported_envelope():
+    ok = _cfg("churn", 64)
+    assert mega_supported(ok)
+    too_big = SimConfig(max_nnb=1 << 14, model="overlay",
+                        single_failure=True, drop_msg=False,
+                        total_ticks=100, step_rate=40.0 / (1 << 14))
+    assert not mega_supported(too_big)
+    # a user-set view width that overflows the 128-lane plane
+    wide = SimConfig(max_nnb=64, model="overlay", single_failure=True,
+                     drop_msg=False, total_ticks=100, step_rate=0.5,
+                     overlay_view=64)
+    assert not mega_supported(wide)
