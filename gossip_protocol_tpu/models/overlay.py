@@ -474,11 +474,16 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
     comm = comm or LocalOverlayComm()
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
-    use_kernel = bool(use_pallas) and isinstance(comm, LocalOverlayComm)
     powerlaw = cfg.topology == "powerlaw"
     can_rejoin = cfg.churn_rate > 0 or cfg.rejoin_after is not None
     n = cfg.n
     k, f = resolved_dims(cfg)
+    # shapes outside the fused kernel's envelope (k >= N_COUNTERS
+    # metric lanes, n >= 8 sublane block) fall back to the
+    # bit-identical XLA phases instead of tripping kernel asserts
+    from ..ops.pallas.overlay_exchange import N_COUNTERS
+    use_kernel = bool(use_pallas) and isinstance(comm, LocalOverlayComm) \
+        and k >= N_COUNTERS and n >= 8
     t_remove = cfg.t_remove
     assert n & (n - 1) == 0, "overlay peer count must be a power of two " \
         "(XOR partner exchange)"

@@ -82,3 +82,78 @@ def test_kernel_small_block_sizes():
     for t, (sx, mx, sp, mp) in enumerate(_run_both(cfg, 60)):
         _assert_state_equal(sx, sp, t)
         _assert_metrics_equal(mx, mp, t)
+
+
+def test_kernel_multiblock_xor_dma_path():
+    """A small block_rows forces nb > 1, exercising the
+    scalar-prefetch block-index-map XOR DMA path (block i sources
+    block ``i ^ (m // b)``) that the default 512-row block never hits
+    at test sizes; the powerlaw case also covers the F > 4
+    block-halving branch (fused_overlay_tick's VMEM gate)."""
+    import jax.numpy as jnp
+
+    from gossip_protocol_tpu.models.overlay import (
+        exchange_mask, make_overlay_schedule, resolved_dims)
+    from gossip_protocol_tpu.ops.pallas.overlay_exchange import (
+        fused_overlay_tick)
+
+    for topology in ("uniform", "powerlaw"):
+        # fanout capped at 7 for the powerlaw case: still > 4 (the
+        # block-halving branch), avoiding the documented 8-round
+        # XLA:CPU interpret pathology (ops/pallas/overlay_mega.py)
+        cfg = SimConfig(max_nnb=64, model="overlay", single_failure=True,
+                        drop_msg=False, seed=13, total_ticks=80,
+                        fail_tick=30, step_rate=0.5, topology=topology,
+                        fanout=0 if topology == "uniform" else 7)
+        n = cfg.n
+        k, f = resolved_dims(cfg)
+        sched = make_overlay_schedule(cfg)
+        tick_x = jax.jit(make_overlay_tick(cfg, use_pallas=False))
+        state = init_overlay_state(cfg)
+        # run the XLA path to a mid-run state with live traffic
+        for _ in range(24):
+            state, _ = tick_x(state, sched)
+        t = state.tick
+        i32 = jnp.int32
+        ids0, hb0, ts0 = state.ids, state.hb, state.ts
+        p0 = jnp.where(ids0 >= 0, ((ts0 + 1) << 12) | (hb0 + 1), 0)
+        proc = jnp.ones((n,), bool)
+        ops = proc & state.in_group
+        bits = (proc.astype(i32) | (ops.astype(i32) << 1))
+        idsaux = jnp.concatenate([
+            ids0, state.own_hb[:, None], bits[:, None],
+            state.send_flags.astype(i32)], 1)
+        intro = jnp.zeros((8, k), i32) \
+            .at[0].set(ids0[0]).at[1].set(p0[0]) \
+            .at[2, 0].set(state.own_hb[0])
+        masks = jnp.stack([exchange_mask(sched.seed, t - 1, fi, n)
+                           for fi in range(f)])
+        scalars = jnp.stack([t, sched.seed.astype(i32), sched.victim_lo,
+                             sched.victim_hi, sched.fail_tick,
+                             sched.rejoin_after,
+                             sched.churn_thr.astype(i32),
+                             sched.churn_after])
+        kw = dict(k=k, t_remove=cfg.t_remove,
+                  churn_lo=cfg.total_ticks // 4,
+                  churn_span=max(cfg.total_ticks // 2, 1))
+        ref = fused_overlay_tick(idsaux, p0, intro, masks, scalars, **kw)
+        multi = fused_overlay_tick(idsaux, p0, intro, masks, scalars,
+                                   block_rows=16, **kw)
+        for name, r, m in zip(("ids", "hb", "ts", "ctr"), ref, multi):
+            assert np.array_equal(np.asarray(r), np.asarray(m)), \
+                f"{topology}: {name} diverged between nb=1 and nb>1"
+
+
+def test_tiny_view_falls_back_to_xla():
+    """overlay_view < N_COUNTERS must not trip kernel asserts: the
+    use_kernel gate routes such shapes to the XLA phases (round-2
+    advisor finding)."""
+    cfg = SimConfig(max_nnb=16, model="overlay", single_failure=True,
+                    drop_msg=False, seed=3, total_ticks=60, fail_tick=30,
+                    step_rate=0.5, overlay_view=4)
+    sched = make_overlay_schedule(cfg)
+    tick = jax.jit(make_overlay_tick(cfg, use_pallas=True))
+    state = init_overlay_state(cfg)
+    for _ in range(10):
+        state, _ = tick(state, sched)
+    assert int(np.asarray(state.in_group).sum()) > 0
