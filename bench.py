@@ -135,29 +135,43 @@ def _check_recover(cfg, result):
     exact same fail/rejoin script — for that bound and require every
     snapshot-uncovered member to be covered again.
     """
+    import jax
+    import jax.numpy as jnp
     import numpy as np
 
     from gossip_protocol_tpu.models.overlay import (SLOT_EPOCH,
-                                                    OverlayResult,
                                                     make_overlay_run)
     uncovered, victims_left = result.final_coverage()
     if victims_left:
         raise RuntimeError("overlay bench: victim entries left")
     if not uncovered:
         return 0
-    before = set(result.uncovered_members().tolist())
+    # the guarantee is coverage at ANY tick within the bound (matching
+    # test_recover_bound), so accumulate per-tick coverage across the
+    # continuation rather than checking only the endpoint snapshot —
+    # an unrelated fresh transient at the final tick must not fail a
+    # run that satisfied the property
+    before = result.uncovered_members()
     bound = SLOT_EPOCH + 1
-    run = make_overlay_run(cfg, bound)
-    final2, m2 = run(result.final_state, result.sched)
-    import jax
-    cont = OverlayResult(cfg=cfg, sched=result.sched, final_state=final2,
-                         metrics=jax.tree.map(np.asarray, m2),
-                         wall_seconds=0.0)
-    after = set(cont.uncovered_members().tolist())
-    if before & after:
+    n = cfg.n
+    run1 = make_overlay_run(cfg, 1)
+
+    @jax.jit
+    def covered_of(state):
+        flat = jnp.clip(state.ids, 0).reshape(-1)
+        return jnp.zeros(n, bool).at[flat].max(
+            (state.ids >= 0).reshape(-1))
+
+    state = result.final_state
+    covered_any = jnp.zeros(n, bool)
+    for _ in range(bound):
+        state, _ = run1(state, result.sched)
+        covered_any = covered_any | covered_of(state)
+    still = before[~np.asarray(covered_any)[before]]
+    if still.size:
         raise RuntimeError(
             f"overlay bench: coverage hole persisted past the "
-            f"{bound}-tick re-cover bound ({sorted(before & after)[:5]}...)")
+            f"{bound}-tick re-cover bound ({still[:5].tolist()}...)")
     return len(before)
 
 

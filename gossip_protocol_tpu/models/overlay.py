@@ -465,9 +465,12 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
     (tests/test_overlay_sharded.py).
 
     ``use_pallas`` routes the exchange+merge hot phase through the
-    fused Pallas kernel (ops/pallas/overlay_exchange.py — single-device
-    path only; None = auto: on for TPU backends).  The kernel is
-    bit-identical to the XLA phases (tests/test_overlay_pallas.py) and
+    fused Pallas kernel (ops/pallas/overlay_exchange.py; None = auto:
+    on for TPU backends) on both the single-device and sharded paths —
+    under ``shard_map`` the comm ppermutes each round's payload plane
+    by the mask's shard bits and the kernel handles the shard-local
+    bits.  The kernel is bit-identical to the XLA phases
+    (tests/test_overlay_pallas.py, tests/test_overlay_sharded.py) and
     measured faster on v5e (per tick: ~3.4ms vs ~4.3ms at 65k, ~57ms
     vs ~106ms at 1M — scripts/profile_tick.py, 200-tick scans).
     """
@@ -479,11 +482,13 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
     n = cfg.n
     k, f = resolved_dims(cfg)
     # shapes outside the fused kernel's envelope (k >= N_COUNTERS
-    # metric lanes, n >= 8 sublane block) fall back to the
-    # bit-identical XLA phases instead of tripping kernel asserts
+    # metric lanes, >= 8 locally-held rows) fall back to the
+    # bit-identical XLA phases instead of tripping kernel asserts.
+    # The kernel is comm-generic: under shard_map the comm routes the
+    # exchange's shard-index bits (ppermute per round) and the kernel
+    # handles the shard-local bits (round-2 verdict task — the v4-8
+    # path previously inherited the ~2x-slower XLA tick).
     from ..ops.pallas.overlay_exchange import N_COUNTERS
-    use_kernel = bool(use_pallas) and isinstance(comm, LocalOverlayComm) \
-        and k >= N_COUNTERS and n >= 8
     t_remove = cfg.t_remove
     assert n & (n - 1) == 0, "overlay peer count must be a power of two " \
         "(XOR partner exchange)"
@@ -496,6 +501,7 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
     nl = n // p
     assert nl * p == n and nl & (nl - 1) == 0, \
         "shard count must divide the peer count (both powers of two)"
+    use_kernel = bool(use_pallas) and k >= N_COUNTERS and nl >= 8
     factors = _xor_factors(nl)
     with_coverage = n <= COVERAGE_N_LIMIT
 
@@ -618,40 +624,62 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
         self_entry_fresh = t_remove > 1
 
         if use_kernel:
-            # ---- the whole (N, K) phase in one Pallas launch -------
+            # ---- the whole (Nl, K) phase in one Pallas launch ------
             # (ops/pallas/overlay_exchange.py): accumulator init +
             # proc gating + F exchange rounds + JOINREP/JOINREQ +
-            # winner extraction + detection + per-row metric counts
+            # winner extraction + detection + per-row metric counts.
+            # Under shard_map the comm ppermutes each round's whole
+            # payload plane by the mask's shard bits; the kernel
+            # applies the shard-local bits and receives global row
+            # identity via row_start.
             from ..ops.pallas.overlay_exchange import fused_overlay_tick
             masks = jnp.stack([exchange_mask(seed, t - 1, fi, n)
                                for fi in range(f)])
             i32 = jnp.int32
-            bits = (proc.astype(i32) | (ops.astype(i32) << 1)
-                    | (jrep.astype(i32) << 2))
+            bits_l = (proc_l.astype(i32) | (ops_l.astype(i32) << 1)
+                      | (jrep_l.astype(i32) << 2))
             idsaux = jnp.concatenate([
-                ids0, own_hb0_l[:, None], bits[:, None],
-                state.send_flags.astype(i32)], 1)      # (N, K+2+F)
+                ids0, own_hb0_l[:, None], bits_l[:, None],
+                state.send_flags.astype(i32)], 1)      # (Nl, K+2+F)
+            bc = comm.bcast_row0(jnp.concatenate(
+                [ids0, p0, own_hb0_l[:, None]], 1))    # (2K+1,) introducer
             zk = jnp.zeros((k,), i32)
             intro = jnp.stack([
-                ids0[INTRODUCER], p0[INTRODUCER],
-                jnp.zeros((k,), i32).at[0].set(own_hb0[INTRODUCER]),
+                bc[:k], bc[k:2 * k],
+                jnp.zeros((k,), i32).at[0].set(bc[2 * k]),
                 q_kf.astype(i32), q_pf,
                 zk, zk, zk])                           # (8, K)
             scalars = jnp.stack([
                 t, seed.astype(i32), sched.victim_lo, sched.victim_hi,
                 sched.fail_tick, sched.rejoin_after,
                 sched.churn_thr.astype(i32), sched.churn_after])
+            if p == 1:
+                aux_rounds = pw_rounds = None
+                masks_local = None
+                vma = ()
+            else:
+                vma = (comm.axis,)
+                aux_rounds = jnp.stack(
+                    [comm.xor_perm_shards(idsaux, masks[fi] // nl)
+                     for fi in range(f)])
+                pw_rounds = jnp.stack(
+                    [comm.xor_perm_shards(p0, masks[fi] // nl)
+                     for fi in range(f)])
+                masks_local = masks % nl
             ids2, hb2, ts2, ctr = fused_overlay_tick(
                 idsaux, p0, intro, masks, scalars,
                 k=k, t_remove=t_remove,
                 churn_lo=cfg.total_ticks // 4,
-                churn_span=max(cfg.total_ticks // 2, 1))
-            recv_cnt = ctr[:, 0].sum() + joins_recv
-            removals = ctr[:, 1].sum()
-            false_removals = ctr[:, 2].sum()
-            victims_cnt = ctr[:, 3].sum()
-            adds_cnt = ctr[:, 4].sum()
-            view_cnt = ctr[:, 5].sum()
+                churn_span=max(cfg.total_ticks // 2, 1),
+                masks_local=masks_local,
+                row_start=jnp.int32(0) + row_start,
+                aux_rounds=aux_rounds, pw_rounds=pw_rounds, vma=vma)
+            recv_cnt = comm.psum(ctr[:, 0].sum()) + joins_recv
+            removals = comm.psum(ctr[:, 1].sum())
+            false_removals = comm.psum(ctr[:, 2].sum())
+            victims_cnt = comm.psum(ctr[:, 3].sum())
+            adds_cnt = comm.psum(ctr[:, 4].sum())
+            view_cnt = comm.psum(ctr[:, 5].sum())
             ids_pre = ids2      # pre-re-roll table (kernel output is
             #                     pre-remap; the re-roll runs below)
         else:

@@ -115,18 +115,25 @@ _SHARDED_CACHE: dict = {}
 
 
 def make_sharded_overlay_run(cfg: SimConfig, mesh: Mesh,
-                             axis: str = PEER_AXIS):
+                             axis: str = PEER_AXIS,
+                             use_pallas: bool | None = None):
     """Build ``run(state, sched) -> (final, metrics[T])`` with the
-    scan-over-ticks inside ``shard_map`` over ``mesh``."""
+    scan-over-ticks inside ``shard_map`` over ``mesh``.
+
+    ``use_pallas`` (None = auto: on for TPU) routes the per-shard
+    (Nl, K) phase through the fused kernel with the comm ppermuting
+    the exchange's shard bits — see make_overlay_tick."""
     n_shards = mesh.devices.size
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
     key = (cfg.n, cfg.t_remove, cfg.total_ticks, cfg.overlay_view,
-           cfg.fanout, cfg.topology,
+           cfg.fanout, cfg.topology, use_pallas,
            cfg.churn_rate > 0 or cfg.rejoin_after is not None, axis, mesh)
     if key in _SHARDED_CACHE:
         return _SHARDED_CACHE[key]
 
     comm = RingOverlayComm(axis, n_shards)
-    tick = make_overlay_tick(cfg, comm=comm)
+    tick = make_overlay_tick(cfg, comm=comm, use_pallas=use_pallas)
 
     def body(state: OverlayState, sched: OverlaySchedule):
         def step(carry, _):
@@ -137,6 +144,11 @@ def make_sharded_overlay_run(cfg: SimConfig, mesh: Mesh,
         body, mesh=mesh,
         in_specs=(_state_specs(axis), _sched_specs()),
         out_specs=(_state_specs(axis), _metric_specs()),
+        # the fused kernel's scalar-prefetch vector mixes shard-varying
+        # (row_start) and replicated scalars, which VMA typing inside
+        # the pallas machinery rejects (jax suggests this exact
+        # workaround); the XLA path keeps the strict check
+        check_vma=not use_pallas,
     )
     run = jax.jit(shmapped)
     _SHARDED_CACHE[key] = run

@@ -77,7 +77,10 @@ def _kernel(b: int, w_cols: int, k: int, f_rounds: int, t_remove: int,
             churn_lo: int, churn_span: int, never: int,
             # scalar prefetch: [t, seed, victim_lo, victim_hi,
             #   fail_tick, rejoin_after, churn_thr, churn_after,
-            #   m_0 .. m_{F-1}]
+            #   row_start, mlo_0 .. mlo_{F-1}, m_0 .. m_{F-1}]
+            # (mlo = shard-local mask bits for the block index map;
+            #  m = the global mask for partner identity — identical
+            #  on a single device)
             sp_ref,
             # inputs
             *refs):
@@ -103,9 +106,10 @@ def _kernel(b: int, w_cols: int, k: int, f_rounds: int, t_remove: int,
     rejoin_after = sp_ref[5]
     churn_thr = sp_ref[6].astype(jnp.uint32)
     churn_after = sp_ref[7]
+    row_start = sp_ref[8]                          # global id of local row 0
 
     rbits = jax.lax.broadcasted_iota(jnp.int32, (b, 1), 0)
-    rows = i_blk * b + rbits                       # (B, 1) global rows
+    rows = row_start + i_blk * b + rbits           # (B, 1) global rows
     rows_u = rows.astype(jnp.uint32)
     kk = jax.lax.broadcasted_iota(jnp.int32, (b, k), 1)
     lgb = b.bit_length() - 1
@@ -133,14 +137,15 @@ def _kernel(b: int, w_cols: int, k: int, f_rounds: int, t_remove: int,
 
     # ---- F exchange rounds -----------------------------------------
     for fi in range(f_rounds):
-        m = sp_ref[8 + fi]
-        # butterfly the mask's low bits, predicated per bit
+        m_lo = sp_ref[9 + fi]                # shard-local mask bits
+        m = sp_ref[9 + f_rounds + fi]        # global mask (partner id)
+        # butterfly the local mask's low bits, predicated per bit
         wa_scr[:] = ia_x[fi][:]
         wp_scr[:] = pw_x[fi][:]
         for j in range(lgb):
             s = 1 << j
 
-            @pl.when(((m >> j) & 1) == 1)
+            @pl.when(((m_lo >> j) & 1) == 1)
             def _swap(s=s, j=j):
                 sel = ((rbits >> j) & 1) == 0
                 cur_a = wa_scr[:]
@@ -255,31 +260,45 @@ def _kernel(b: int, w_cols: int, k: int, f_rounds: int, t_remove: int,
 @functools.partial(jax.jit,
                    static_argnames=("k", "t_remove", "churn_lo",
                                     "churn_span", "block_rows",
-                                    "interpret"))
+                                    "interpret", "vma"))
 def fused_overlay_tick(idsaux, pw, intro, masks, scalars, *,
                        k: int, t_remove: int, churn_lo: int,
                        churn_span: int, block_rows: int = 512,
-                       interpret: bool | None = None):
+                       interpret: bool | None = None,
+                       masks_local=None, row_start=None,
+                       aux_rounds=None, pw_rounds=None,
+                       vma: tuple = ()):
     """The overlay tick's whole (N, K) phase in one Pallas launch.
 
     Args:
-      idsaux: i32[N, K+2+F] — lanes [0, K) the (post-wipe) view ids,
+      idsaux: i32[Nl, K+2+F] — lanes [0, K) the (post-wipe) view ids,
         lane K own_hb, lane K+1 the packed proc|ops<<1|jrep<<2 bits,
         lanes [K+2, K+2+F) the per-round send flags.  Stored
         lane-padded to 128 on TPU anyway, so the aux lanes are free.
-      pw: i32[N, K] — the packed (ts, hb) payload words (_pack_th; 0
+        Nl = the locally-held rows (= N on a single device).
+      pw: i32[Nl, K] — the packed (ts, hb) payload words (_pack_th; 0
         for empty slots is fine, ids gate validity).
       intro: i32[8, K] — row 0 the introducer's ids, row 1 its packed
         words, row 2 lane 0 its own_hb, row 3 the JOINREQ per-slot key
         aggregate (uint32 bits), row 4 the matching packed payloads.
-      masks: i32[F] — this tick's XOR masks.
+      masks: i32[F] — this tick's GLOBAL XOR masks (partner identity).
       scalars: i32[8] — [t, seed, victim_lo, victim_hi, fail_tick,
         rejoin_after, churn_thr (uint32 bits), churn_after].
       churn_lo/churn_span: static schedule constants (cfg.total_ticks
         derived — the run cache is keyed on them).
 
-    Returns ``(ids2 i32[N, K], hb2 i32[N, K], ts2 i32[N, K],
-    counters i32[N, N_COUNTERS])`` — counters columns are per-row
+    Sharded execution (inside ``shard_map``): the XOR exchange
+    decomposes as ``i ^ m = (s ^ m_hi)*Nl + (il ^ m_lo)`` — the comm
+    routes the shard bits by ppermuting whole planes per round
+    (``aux_rounds``/``pw_rounds``, each i32[F, Nl, ...]), while this
+    kernel applies only the local bits ``masks_local = m % Nl`` in its
+    block index map / butterfly.  ``row_start`` is the global id of
+    local row 0 (receiver identity for the per-receiver tie hash,
+    partner ids, and the introducer row match).  All four default to
+    the single-device identity.
+
+    Returns ``(ids2 i32[Nl, K], hb2 i32[Nl, K], ts2 i32[Nl, K],
+    counters i32[Nl, N_COUNTERS])`` — counters columns are per-row
     [recv, removals, false_removals, victim_slots, adds, view_slots].
     """
     if interpret is None:
@@ -288,6 +307,14 @@ def fused_overlay_tick(idsaux, pw, intro, masks, scalars, *,
     f_rounds = int(masks.shape[0])
     assert w_cols == k + 2 + f_rounds, (w_cols, k, f_rounds)
     assert k >= N_COUNTERS
+    if masks_local is None:
+        masks_local = masks % n
+    if row_start is None:
+        row_start = jnp.int32(0)
+    if aux_rounds is None:
+        aux_rounds = jnp.broadcast_to(idsaux, (f_rounds,) + idsaux.shape)
+    if pw_rounds is None:
+        pw_rounds = jnp.broadcast_to(pw, (f_rounds,) + pw.shape)
     # each of the 1+F bindings of the two table planes double-buffers a
     # (B, <=128)-lane block in VMEM; at F > 4 a 512-row block exceeds
     # the 16 MB scoped budget (measured: 16.14M at F=8), so halve it
@@ -296,7 +323,9 @@ def fused_overlay_tick(idsaux, pw, intro, masks, scalars, *,
     nb = n // b
 
     i32 = jnp.int32
-    sp = jnp.concatenate([scalars.astype(i32), masks.astype(i32)])
+    sp = jnp.concatenate([scalars.astype(i32),
+                          jnp.reshape(row_start, (1,)).astype(i32),
+                          masks_local.astype(i32), masks.astype(i32)])
 
     row_block_w = pl.BlockSpec((b, w_cols), lambda i, sp_ref: (i, 0),
                                memory_space=pltpu.VMEM)
@@ -306,7 +335,7 @@ def fused_overlay_tick(idsaux, pw, intro, masks, scalars, *,
     def xor_spec(fi, cols):
         return pl.BlockSpec(
             (b, cols),
-            lambda i, sp_ref, fi=fi: (i ^ (sp_ref[8 + fi] // b), 0),
+            lambda i, sp_ref, fi=fi: (i ^ (sp_ref[9 + fi] // b), 0),
             memory_space=pltpu.VMEM)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -333,10 +362,11 @@ def fused_overlay_tick(idsaux, pw, intro, masks, scalars, *,
                           churn_lo, churn_span, int(NEVER)),
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((n, k), i32),
-            jax.ShapeDtypeStruct((n, k), i32),
-            jax.ShapeDtypeStruct((n, 2 * k), i32),
+            jax.ShapeDtypeStruct((n, k), i32, vma=frozenset(vma)),
+            jax.ShapeDtypeStruct((n, k), i32, vma=frozenset(vma)),
+            jax.ShapeDtypeStruct((n, 2 * k), i32, vma=frozenset(vma)),
         ],
         interpret=interpret,
-    )(sp, idsaux, pw, *([idsaux] * f_rounds), *([pw] * f_rounds), intro)
+    )(sp, idsaux, pw, *[aux_rounds[fi] for fi in range(f_rounds)],
+      *[pw_rounds[fi] for fi in range(f_rounds)], intro)
     return ids2, hb2, tsc[:, :k], tsc[:, k:k + N_COUNTERS]

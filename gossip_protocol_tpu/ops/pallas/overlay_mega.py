@@ -111,14 +111,6 @@ MET_RECV = 7
 _SIGN = np.uint32(0x80000000)
 
 
-def _roll_rows(x, shift: int):
-    """Static circular roll along sublanes (concat of static slices)."""
-    s = shift % x.shape[0]
-    if s == 0:
-        return x
-    return jnp.concatenate([x[-s:], x[:-s]], axis=0)
-
-
 def _umax0(x):
     """Column-wise uint32 max over sublanes via the sign-flip trick —
     Mosaic legalizes signed i32 reductions but not unsigned ones."""
@@ -141,7 +133,7 @@ def _lex(kmax, pacc, key_c, p_c):
 
 def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, t_remove: int,
             churn_lo: int, churn_span: int, never: int, can_rejoin: bool,
-            powerlaw: bool, dbg: tuple,
+            powerlaw: bool,
             sp_ref, st_in, st_out, met_out, *w_refs):
     from ...config import INTRODUCER
     from ...models.overlay import (ID_MASK, SLOT_EPOCH, _SALT_CHURN,
@@ -212,29 +204,28 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, t_remove: int,
         bc = st_out[INTRODUCER:INTRODUCER + 1, :]            # (1, W)
 
         # ---- phase A2 (whole plane): F XOR butterflies -------------
-        # Every bit level applies unconditionally (select on the mask
-        # bit) instead of a pl.when per level: measured, a cond per
-        # level makes the interpret-mode XLA:CPU compile blow up
-        # superlinearly (>500 s at 18 conds/tick), while the extra
-        # rolls are VMEM-bandwidth noise on TPU.
+        # The tick's wall-clock at mega sizes is per-vector-op
+        # overhead (measured ~flat in N from 512 to 4096), so each
+        # bit level is ONE group-roll concat — x[r ^ s] equals a
+        # roll-by-s within each 2s-row group — and pl.when skips
+        # unset mask bits at scalar-branch cost.
         for fi in range(f_rounds):
             m = sp_ref[_SP_NSCALARS + s * f_rounds + fi]
             w_refs[fi][:] = st_out[:]
-            for j in range(0 if 'nofly' in dbg else n.bit_length() - 1):
+            for j in range(n.bit_length() - 1):
                 sh = 1 << j
-                mbit = ((m >> j) & 1) == 1
-                sel = ((rows_n >> j) & 1) == 0
-                cur = w_refs[fi][:]
-                swapped = jnp.where(sel, _roll_rows(cur, -sh),
-                                    _roll_rows(cur, sh))
-                w_refs[fi][:] = jnp.where(mbit, swapped, cur)
+
+                @pl.when(((m >> j) & 1) == 1)
+                def _swap(fi=fi, sh=sh):
+                    cur = w_refs[fi][:]
+                    z = cur.reshape(n // (2 * sh), 2 * sh, w)
+                    w_refs[fi][:] = jnp.concatenate(
+                        [z[:, sh:], z[:, :sh]], axis=1).reshape(n, w)
 
         # ---- phase B (row chunks): the whole per-row pipeline ------
         met_out[pl.ds(s, 1), :] = jnp.zeros((1, 128), i32)
 
         def chunk(c, _):
-            if 'nochunk' in dbg:
-                return ()
             r0 = c * b
             rows = rows_b0 + r0
             rows_u = rows.astype(jnp.uint32)
@@ -388,21 +379,20 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, t_remove: int,
             joinrep_next = joinrep_sent | (joinrep_c & live_hold)
 
             # metrics: accumulate into this tick's row
-            deltas = (
-                (MET_IN_GROUP, _sum_all(in_group)),
-                (MET_VIEW, _sum_all(ids2 >= 0)),
-                (MET_ADDS, _sum_all((ids1 != ids0) & (ids1 >= 0))),
-                (MET_REMOVALS, _sum_all(stale)),
-                (MET_FALSE_REMOVALS, _sum_all(stale & ~subj_failed)),
-                (MET_VICTIM,
-                 _sum_all((ids2 >= 0) & subj_failed & ~stale)),
-                (MET_SENT, _sum_all(sf_next) + _sum_all(joinreq_sent)
-                 + _sum_all(joinrep_sent)),
-                (MET_RECV, _sum_all(recv) + _sum_all(jrep)),
-            )
-            for col, d in (() if 'nomet' in dbg else deltas):
-                met_out[pl.ds(s, 1), pl.ds(col, 1)] = \
-                    met_out[pl.ds(s, 1), pl.ds(col, 1)] + d
+            # one packed (1, 8) accumulate; lane order must match
+            # the MET_* column constants
+            delta = jnp.concatenate([
+                _sum_all(in_group),
+                _sum_all(ids2 >= 0),
+                _sum_all((ids1 != ids0) & (ids1 >= 0)),
+                _sum_all(stale),
+                _sum_all(stale & ~subj_failed),
+                _sum_all((ids2 >= 0) & subj_failed & ~stale),
+                _sum_all(sf_next) + _sum_all(joinreq_sent)
+                + _sum_all(joinrep_sent),
+                _sum_all(recv) + _sum_all(jrep),
+            ], axis=1)
+            met_out[pl.ds(s, 1), 0:8] = met_out[pl.ds(s, 1), 0:8] + delta
 
             # write the end-of-tick chunk
             sf_i = sf_next.astype(i32)
@@ -422,8 +412,6 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, t_remove: int,
             met_out[pl.ds(s, 1), pl.ds(MET_RECV, 1)] + jreq_cnt
 
         # ---- phase C (whole plane): SLOT_EPOCH re-roll -------------
-        if 'noreslot' in dbg:
-            return ()
         @pl.when((t + 1) % SLOT_EPOCH == 0)
         def _reslot():
             cur = st_out[:]
@@ -472,11 +460,11 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, t_remove: int,
 @functools.partial(
     jax.jit, static_argnames=("n", "k", "f_rounds", "s_ticks", "t_remove",
                               "churn_lo", "churn_span", "can_rejoin",
-                              "powerlaw", "interpret", "dbg"))
+                              "powerlaw", "interpret"))
 def mega_overlay_ticks(st, sp, *, n: int, k: int, f_rounds: int,
                        s_ticks: int, t_remove: int, churn_lo: int,
                        churn_span: int, can_rejoin: bool, powerlaw: bool,
-                       interpret: bool | None = None, dbg: tuple = ()):
+                       interpret: bool | None = None):
     """Run ``s_ticks`` whole overlay ticks in one Pallas launch.
 
     Args:
@@ -510,7 +498,7 @@ def mega_overlay_ticks(st, sp, *, n: int, k: int, f_rounds: int,
     st2, met = pl.pallas_call(
         functools.partial(_kernel, n, k, f_rounds, s_ticks, t_remove,
                           churn_lo, churn_span, int(NEVER), can_rejoin,
-                          powerlaw, dbg),
+                          powerlaw),
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct((n, w), jnp.int32),
                    jax.ShapeDtypeStruct((s_ticks, 128), jnp.int32)],

@@ -25,12 +25,11 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent compilation cache: the suite compiles dozens of scan/kernel
-# variants (notably the megakernel's per-(k, f, s_ticks) instances);
-# caching them across runs cuts repeat suite time substantially.
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.expanduser("~/.cache/gossip_tpu_jax"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# NOTE: do NOT enable jax's persistent compilation cache here.  It was
+# tried (round 3) and a cache entry corrupted by a killed process made
+# deserialization abort() the whole pytest run with no Python-level
+# error — a silent suite-killer worth far more than the compile time
+# it saves.
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -17,7 +17,7 @@ from gossip_protocol_tpu.models.overlay_sharded import (
     make_overlay_mesh, make_sharded_overlay_run, shard_overlay_state)
 
 
-def _run_both(cfg, n_devices):
+def _run_both(cfg, n_devices, use_pallas=None):
     sched = make_overlay_schedule(cfg)
     state = init_overlay_state(cfg)
 
@@ -25,9 +25,25 @@ def _run_both(cfg, n_devices):
     final_l, metrics_l = run_local(state, sched)
 
     mesh = make_overlay_mesh(n_devices)
-    run_sharded = make_sharded_overlay_run(cfg, mesh)
+    run_sharded = make_sharded_overlay_run(cfg, mesh, use_pallas=use_pallas)
     final_s, metrics_s = run_sharded(shard_overlay_state(state, mesh), sched)
     return (final_l, metrics_l), (final_s, metrics_s)
+
+
+STATE_FIELDS = ("ids", "hb", "ts", "send_flags", "in_group", "own_hb",
+                "joinreq", "joinrep", "tick")
+
+
+def _assert_equal(fl, ml, fs, ms):
+    import dataclasses
+    for field in STATE_FIELDS:
+        a = np.asarray(getattr(fl, field))
+        b = np.asarray(getattr(fs, field))
+        assert np.array_equal(a, b), field
+    for f in dataclasses.fields(type(ml)):
+        a = np.asarray(getattr(ml, f.name))
+        b = np.asarray(getattr(ms, f.name))
+        assert np.array_equal(a, b), f.name
 
 
 @pytest.mark.parametrize("n_devices", [2, 8])
@@ -43,20 +59,66 @@ def test_sharded_bit_parity(n_devices, scenario):
                   total_ticks=120)
     cfg = SimConfig(**kw)
     (fl, ml), (fs, ms) = _run_both(cfg, n_devices)
-
-    for field in ("ids", "hb", "ts", "send_flags", "in_group", "own_hb",
-                  "joinreq", "joinrep", "tick"):
-        a = np.asarray(getattr(fl, field))
-        b = np.asarray(getattr(fs, field))
-        assert np.array_equal(a, b), field
-    import dataclasses
-    for f in dataclasses.fields(type(ml)):
-        a = np.asarray(getattr(ml, f.name))
-        b = np.asarray(getattr(ms, f.name))
-        assert np.array_equal(a, b), f.name
+    _assert_equal(fl, ml, fs, ms)
 
 
 def test_sharded_rejects_non_power_of_two_mesh():
     from gossip_protocol_tpu.models.overlay_sharded import RingOverlayComm
     with pytest.raises(AssertionError, match="power of two"):
         RingOverlayComm("peers", 3)
+
+
+@pytest.mark.parametrize("n_devices", [2, 8])
+def test_sharded_kernel_bit_parity(n_devices):
+    """The fused Pallas kernel under shard_map (interpret mode on the
+    virtual mesh): comm ppermutes the exchange's shard bits, the
+    kernel applies the local bits — bit-identical to the XLA
+    single-device trajectory (round-2 verdict task 2)."""
+    cfg = SimConfig(model="overlay", max_nnb=128, seed=7, total_ticks=90,
+                    single_failure=True, drop_msg=True, msg_drop_prob=0.1,
+                    fail_tick=40, drop_open_tick=10, drop_close_tick=80,
+                    step_rate=0.5)
+    (fl, ml), (fs, ms) = _run_both(cfg, n_devices, use_pallas=True)
+    _assert_equal(fl, ml, fs, ms)
+
+
+@pytest.mark.slow
+def test_sharded_kernel_parity_n1024():
+    """Non-toy sharded kernel shapes: Nl = 128 spans multiple 8-row
+    sublane tiles and multi-block index maps (round-2 verdict task 5:
+    block-geometry interactions only appear past toy N)."""
+    cfg = SimConfig(model="overlay", max_nnb=1024, seed=9, total_ticks=60,
+                    single_failure=True, drop_msg=False, fail_tick=30,
+                    step_rate=4.0)
+    (fl, ml), (fs, ms) = _run_both(cfg, 8, use_pallas=True)
+    _assert_equal(fl, ml, fs, ms)
+
+
+@pytest.mark.slow
+def test_sharded_invariants_n4096():
+    """8-device sharded overlay at N=4096 (~60 ticks): join
+    completeness over the ramp prefix, victim purge by the horizon,
+    and union coverage of live members on the final state — the
+    invariant gates bench.py applies, at a shard geometry where
+    _xor_factors splits and ring-merge block sizes actually vary
+    (round-2 verdict task 5)."""
+    n = 4096
+    cfg = SimConfig(model="overlay", max_nnb=n, seed=2, total_ticks=64,
+                    single_failure=True, drop_msg=False, fail_tick=20,
+                    step_rate=8.0 / n)   # everyone starts by tick 8
+    sched = make_overlay_schedule(cfg)
+    state = init_overlay_state(cfg)
+    mesh = make_overlay_mesh(8)
+    run = make_sharded_overlay_run(cfg, mesh)
+    final, metrics = run(shard_overlay_state(state, mesh), sched)
+    in_group = np.asarray(metrics.in_group)
+    assert in_group[-1] == n, "join incomplete on the sharded mesh"
+    assert np.asarray(metrics.victim_slots)[-1] == 0, "victim not purged"
+    # final-state union coverage of live members
+    from gossip_protocol_tpu.models.overlay import OverlayResult
+    res = OverlayResult(cfg=cfg, sched=sched, final_state=final,
+                        metrics=jax.tree.map(np.asarray, metrics),
+                        wall_seconds=0.0)
+    uncovered, victims_left = res.final_coverage()
+    assert victims_left == 0
+    assert uncovered == 0, f"{uncovered} live members uncovered"
