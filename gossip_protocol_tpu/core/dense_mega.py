@@ -1,0 +1,134 @@
+"""Host harness for the dense full-view megakernel (bench mode).
+
+Packs :class:`~..state.WorldState` plus the schedule columns into the
+dense megakernel's planes (ops/pallas/dense_mega.py), precomputes each
+launch's drop masks with the exact ops/drop.py streams, and runs
+whole-``DENSE_MEGA_TICKS`` launches.  Returns the same
+``(final_state, TickEvents)`` contract as ``make_run(...,
+with_events=False)`` — a drop-in for ``Simulation.run_bench`` —
+and is bit-identical to the per-tick XLA path
+(tests/test_dense_mega.py).
+
+On TPU the launches run inside one jitted ``lax.scan``; on other
+backends each launch dispatches eagerly (same rationale as
+models/overlay_mega.py: inlining interpret-mode kernels into an outer
+jitted scan blows up the XLA:CPU compile).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..config import SimConfig
+from ..ops.drop import tick_drop_masks
+from ..ops.pallas.dense_mega import (DENSE_MEGA_N_LIMIT, DENSE_MEGA_TICKS,
+                                     dense_mega_ticks)
+from ..state import Schedule, WorldState
+
+
+def dense_mega_supported(cfg: SimConfig) -> bool:
+    """Bench-mode dense megakernel envelope (single device)."""
+    return 16 <= cfg.n <= DENSE_MEGA_N_LIMIT and cfg.n % 8 == 0
+
+
+def make_dense_mega_run(cfg: SimConfig):
+    """``run(state, sched) -> (final, TickEvents)`` over the whole run
+    (bench mode: sent/recv counters only, no event masks)."""
+    from .tick import TickEvents
+    assert dense_mega_supported(cfg)
+    n = cfg.n
+    total = cfg.total_ticks
+    s_full = DENSE_MEGA_TICKS
+    n_chunks, rem = divmod(total, s_full)
+    can_rejoin = cfg.rejoin_after is not None
+    kern_kw = dict(n=n, t_remove=cfg.t_remove, can_rejoin=can_rejoin)
+
+    def drop_stack(rng, t0, s_ticks, sched: Schedule):
+        ts = t0 + jnp.arange(s_ticks, dtype=jnp.int32)
+        g, q, p = jax.vmap(
+            lambda t: tick_drop_masks(rng, t, n, sched.drop_active[t],
+                                      sched.drop_prob))(ts)
+        return g, q, p              # (S, N, N), (S, N), (S, N)
+
+    def pack(state: WorldState, sched: Schedule):
+        i32 = jnp.int32
+        aux = jnp.stack([
+            state.in_group.astype(i32), state.own_hb,
+            state.joinreq.astype(i32), state.joinrep.astype(i32),
+            sched.start_tick, sched.fail_tick, sched.rejoin_tick,
+            jnp.zeros((n,), i32)], axis=1)                 # (N, 8)
+        return (state.known.astype(i32), state.hb, state.ts,
+                state.gossip.astype(i32), aux)
+
+    def unpack(planes, aux, tick, rng) -> WorldState:
+        known, hb, ts, gossip = planes
+        return WorldState(
+            tick=tick.astype(jnp.int32), in_group=aux[:, 0] > 0,
+            own_hb=aux[:, 1], known=known > 0, hb=hb, ts=ts,
+            gossip=gossip > 0, joinreq=aux[:, 2] > 0,
+            joinrep=aux[:, 3] > 0, rng=rng)
+
+    def launch(planes, aux, t, state_rng, sched, s_ticks):
+        g, q, p = drop_stack(state_rng, t, s_ticks, sched)
+        sp = jnp.reshape(t, (1,)).astype(jnp.int32)
+        known, hb, ts, gossip = planes
+        known, hb, ts, gossip, aux, sent, recv = dense_mega_ticks(
+            known, hb, ts, gossip, aux, g, q, p, sp,
+            s_ticks=s_ticks, **kern_kw)
+        return (known, hb, ts, gossip), aux, t + s_ticks, sent, recv
+
+    def assemble(planes, aux, t, rng, sents, recvs):
+        sent = jnp.concatenate(sents, 0) if sents \
+            else jnp.zeros((0, n), jnp.int32)
+        recv = jnp.concatenate(recvs, 0) if recvs \
+            else jnp.zeros((0, n), jnp.int32)
+        zeros_t = jnp.zeros((sent.shape[0],), bool)
+        ev = TickEvents(added=zeros_t, removed=zeros_t,
+                        sent=sent, recv=recv)
+        return unpack(planes, aux, t, rng), ev
+
+    def run_body(state: WorldState, sched: Schedule):
+        planes0 = pack(state, sched)
+        planes, aux = planes0[:4], planes0[4]
+        t = state.tick
+        sents, recvs = [], []
+        if n_chunks:
+            def step(carry, _):
+                planes, aux, t = carry
+                planes, aux, t, sent, recv = launch(
+                    planes, aux, t, state.rng, sched, s_full)
+                return (planes, aux, t), (sent, recv)
+            (planes, aux, t), (sent_m, recv_m) = jax.lax.scan(
+                step, (planes, aux, t), None, length=n_chunks)
+            sents.append(sent_m.reshape(n_chunks * s_full, n))
+            recvs.append(recv_m.reshape(n_chunks * s_full, n))
+        if rem:
+            planes, aux, t, sent_r, recv_r = launch(
+                planes, aux, t, state.rng, sched, rem)
+            sents.append(sent_r)
+            recvs.append(recv_r)
+        return assemble(planes, aux, t, state.rng, sents, recvs)
+
+    if jax.default_backend() == "tpu":
+        return jax.jit(run_body, compiler_options={
+            "xla_tpu_scoped_vmem_limit_kib": "114688"})
+
+    def run_eager(state: WorldState, sched: Schedule):
+        planes0 = pack(state, sched)
+        planes, aux = planes0[:4], planes0[4]
+        t = state.tick
+        sents, recvs = [], []
+        for _ in range(n_chunks):
+            planes, aux, t, sent, recv = launch(planes, aux, t,
+                                                state.rng, sched, s_full)
+            sents.append(sent)
+            recvs.append(recv)
+        if rem:
+            planes, aux, t, sent, recv = launch(planes, aux, t,
+                                                state.rng, sched, rem)
+            sents.append(sent)
+            recvs.append(recv)
+        return assemble(planes, aux, t, state.rng, sents, recvs)
+
+    return run_eager

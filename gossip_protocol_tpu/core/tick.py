@@ -332,10 +332,20 @@ def make_run(cfg: SimConfig, block_size: int = 128, with_events: bool = True,
     (benchmark mode — avoids materializing T*(N,N) masks).
     """
     comm = LocalComm(use_pallas)
+    from .dense_mega import dense_mega_supported, make_dense_mega_run
+    mega = (not with_events and comm.use_pallas
+            and dense_mega_supported(cfg))
     key = (cfg.n, cfg.t_remove, cfg.total_ticks, block_size, with_events,
-           comm.use_pallas, cfg.rejoin_after is not None)
+           comm.use_pallas, mega, cfg.rejoin_after is not None)
     if key in _RUN_CACHE:
         return _RUN_CACHE[key]
+    if mega:
+        # bench mode on TPU: DENSE_MEGA_TICKS whole ticks per Pallas
+        # launch, state resident in VMEM — bit-identical to the
+        # per-tick path (tests/test_dense_mega.py)
+        run = make_dense_mega_run(cfg)
+        _RUN_CACHE[key] = run
+        return run
     tick = make_tick(cfg, block_size, comm=comm, with_events=with_events)
 
     @jax.jit

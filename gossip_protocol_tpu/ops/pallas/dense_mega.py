@@ -1,0 +1,313 @@
+"""Multi-tick Pallas megakernel for the DENSE full-view model.
+
+The dense reference-faithful tick (core/tick.py) pays the same fixed
+per-launch + per-dispatch floor the overlay paid before its megakernel
+(docs/PERF.md): at N=512 the whole tick is ~0.5 ms of which the
+useful math — the MXU level-decomposed merge plus (N, N) elementwise
+rules — is tens of microseconds.  This kernel runs ``DENSE_MEGA_TICKS``
+whole dense ticks per launch with the full world state resident in
+VMEM: the four (N, N) planes (known, hb, ts, gossip), the per-peer
+vectors, and the schedule columns.
+
+Everything from core/tick.py's composable path runs in-kernel, in the
+same order and with the same jnp formulas (bit-parity is the contract;
+tests/test_dense_mega.py runs the differential suite):
+
+* phase A — consume in-flight traffic: ``deliver = gossip & proc``,
+  one (N, N) transpose for ``recv_from`` (MP1Node.cpp:200-209 analog);
+* the gossip piggyback merge (MP1Node.cpp:244-256) as the same masked
+  max-over-senders used by ops/merge.py ``_masked_max_mxu``: a
+  level-descend ``lax.while_loop`` whose (N, N) state lives in VMEM
+  scratch refs with a scalar-only carry (Mosaic cannot legalize
+  vector-carried ``scf.while``) and whose witness resolution is one
+  f32 MXU matmul per level — exact, since operands are 0/1 and
+  accumulation is f32;
+* direct-sender increment / add (MP1Node.cpp:236-242), JOINREQ at the
+  introducer (MP1Node.cpp:221-230), JOINREP at the joiner
+  (MP1Node.cpp:231-233), TREMOVE staleness detection
+  (MP1Node.cpp:339-348), full-list dissemination (MP1Node.cpp:350-361)
+  and the sent/recv accounting rows (EmulNet.cpp:111,172).
+
+Drop decisions are NOT derived in-kernel: the dense model's drop masks
+come from ``jax.random`` (ops/drop.py); the harness precomputes the
+per-tick masks for the whole launch outside and passes them as inputs,
+so kernel and XLA paths consume byte-identical randomness.
+
+Scope: bench mode (with_events=False — per-tick sent/recv counters,
+no added/removed event masks), single device, N <= DENSE_MEGA_N_LIMIT
+(VMEM: ~12 live (N, N) i32 planes plus the (S, N, N) drop stack).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: dense ticks per launch
+DENSE_MEGA_TICKS = 16
+
+#: VMEM bound: ~(8 + S/4 + ~12 temporaries) (N, N) i32-equivalent
+#: planes must fit under the raised scoped window
+DENSE_MEGA_N_LIMIT = 512
+
+#: aux lane offsets
+_IN_GROUP = 0
+_OWN_HB = 1
+_JOINREQ = 2
+_JOINREP = 3
+_START = 4
+_FAIL = 5
+_REJOIN = 6
+DENSE_AUX_LANES = 8
+
+_SP_T0 = 0
+
+
+def _kernel(n: int, s_ticks: int, t_remove: int, can_rejoin: bool,
+            sp_ref,
+            known_in, hb_in, ts_in, gossip_in, aux_in,
+            gdrop_ref, qdrop_ref, pdrop_ref,
+            known_o, hb_o, ts_o, gossip_o, aux_o, sent_o, recv_o,
+            m_scr, done_scr, cur_scr):
+    from ...config import INTRODUCER
+
+    i32 = jnp.int32
+    rows = jax.lax.broadcasted_iota(i32, (n, 1), 0)
+    cols = jax.lax.broadcasted_iota(i32, (1, n), 1)
+    self_mask = jax.lax.broadcasted_iota(i32, (n, n), 0) \
+        == jax.lax.broadcasted_iota(i32, (n, n), 1)
+    is_intro = rows == INTRODUCER          # (N, 1)
+    intro_col = cols == INTRODUCER         # (1, N)
+
+    known_o[:] = known_in[:]
+    hb_o[:] = hb_in[:]
+    ts_o[:] = ts_in[:]
+    gossip_o[:] = gossip_in[:]
+    aux_o[:] = aux_in[:]
+
+    def masked_max(d_f32, v):
+        """m[r, j] = max over senders s with d[r, s] of v[s, j]
+        (0 if none) — ops/merge.py _masked_max_mxu ported to scratch
+        refs + scalar-carried while (see module docstring)."""
+        m_scr[:] = jnp.zeros((n, n), i32)
+        done_scr[:] = jnp.zeros((n, n), i32)
+        cur_scr[0:1, :] = v.max(axis=0, keepdims=True)
+
+        def cond(go):
+            return go
+
+        def body(go):
+            cur = cur_scr[0:1, :]
+            w = ((v == cur) & (cur > 0)).astype(jnp.float32)
+            hit = jax.lax.dot_general(
+                d_f32, w, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) > 0
+            done = done_scr[:] > 0
+            newly = hit & ~done
+            m_scr[:] = jnp.where(newly, jnp.broadcast_to(cur, (n, n)),
+                                 m_scr[:])
+            done = done | newly | jnp.broadcast_to(cur == 0, (n, n))
+            done_scr[:] = done.astype(i32)
+            nxt = jnp.where(v < cur, v, 0).max(axis=0, keepdims=True)
+            cur_scr[0:1, :] = nxt
+            return (~done).any() & (nxt > 0).any()
+
+        jax.lax.while_loop(cond, body, jnp.asarray(True))
+        return m_scr[:]
+
+    def tick(s, _):
+        t = sp_ref[_SP_T0] + s
+        aux = aux_o[:]
+        in_group0 = aux[:, _IN_GROUP:_IN_GROUP + 1] > 0
+        own_hb0 = aux[:, _OWN_HB:_OWN_HB + 1]
+        joinreq0 = aux[:, _JOINREQ:_JOINREQ + 1] > 0
+        joinrep0 = aux[:, _JOINREP:_JOINREP + 1] > 0
+        start = aux[:, _START:_START + 1]
+        fail = aux[:, _FAIL:_FAIL + 1]
+        rejoin = aux[:, _REJOIN:_REJOIN + 1]
+
+        failed = (t > fail) & (t <= rejoin)
+        proc = (t > start) & ~failed                     # (N, 1)
+
+        # ---- churn wipe (core/tick.py rejoin re-init) --------------
+        if can_rejoin:
+            rejoining = t == rejoin
+            keep = (~rejoining).astype(i32)
+            known_o[:] = known_o[:] * keep
+            hb_o[:] = hb_o[:] * keep
+            ts_o[:] = ts_o[:] * keep
+            in_group0 = in_group0 & ~rejoining
+            own_hb0 = own_hb0 * keep
+        else:
+            rejoining = jnp.zeros_like(is_intro)
+
+        # introducer gates as (1, 1) broadcastable scalars
+        start0 = aux[INTRODUCER:INTRODUCER + 1, _START:_START + 1]
+        fail0 = aux[INTRODUCER:INTRODUCER + 1, _FAIL:_FAIL + 1]
+        rejoin0 = aux[INTRODUCER:INTRODUCER + 1, _REJOIN:_REJOIN + 1]
+        failed0 = (t > fail0) & (t <= rejoin0)
+        proc0 = (t > start0) & ~failed0                  # (1, 1)
+
+        known_b = known_o[:] > 0
+        hb0 = hb_o[:]
+        ts0 = ts_o[:]
+        gossip_b = gossip_o[:] > 0
+
+        # ---- phase A: consume in-flight traffic --------------------
+        proc_t = jnp.transpose(proc.astype(i32)) > 0     # (1, N)
+        deliver = gossip_b & proc_t                      # [s, r]
+        jreq = joinreq0 & proc0                          # (N, 1)
+        jrep = joinrep0 & proc                           # (N, 1)
+        recv_from = jnp.transpose(deliver.astype(i32)) > 0   # [r, s]
+
+        # ---- nodeStart + per-tick vector decisions -----------------
+        starting = (t == start) | rejoining
+        joinreq_new = starting & ~is_intro
+        in_group = in_group0 | jrep | (starting & is_intro)
+        ops = proc & in_group                            # (N, 1)
+        own_hb = own_hb0 + ops.astype(i32)
+
+        gdrop = gdrop_ref[pl.ds(s, 1)].reshape(n, n)     # bool [s, r]
+        # dynamic slicing must ride the SUBLANE axis (lane-dynamic
+        # offsets need a static multiple-of-128 proof in Mosaic), so
+        # the per-tick vectors are stored (S, N) and transposed here
+        qdrop = jnp.transpose(
+            qdrop_ref[pl.ds(s, 1), :].astype(i32)) > 0   # (N, 1)
+        pdrop = jnp.transpose(
+            pdrop_ref[pl.ds(s, 1), :].astype(i32)) > 0
+        joinreq_sent = joinreq_new & ~qdrop
+        joinrep_sent = jreq & ~pdrop
+        live_hold = ~proc & ~failed                      # (N, 1)
+
+        # ---- piggyback merge (ops/merge.py contract) ---------------
+        k_i = known_b.astype(i32)
+        fresh = k_i * (t - ts0 < t_remove)
+        d_f32 = recv_from.astype(jnp.float32)
+        m_a = masked_max(d_f32, k_i * (hb0 + 1)) - 1
+        m_f = masked_max(d_f32, fresh * (hb0 + 1)) - 1
+        m_t = masked_max(d_f32, fresh * (ts0 + 1)) - 1
+        any_fresh = m_t >= 0
+
+        exists = known_b
+        inc = exists & (m_a > hb0)
+        hb = jnp.where(inc, m_a, hb0)
+        ts = jnp.where(inc, t, ts0)
+        padd = ~exists & any_fresh & ~self_mask
+        hb = jnp.where(padd, m_a, hb)
+        ts = jnp.where(padd, jnp.where(m_a > m_f, t, m_t), ts)
+
+        # ---- direct-sender handling --------------------------------
+        known_pb = exists | padd
+        dinc = recv_from & known_pb
+        hb = jnp.where(dinc, hb + 1, hb)
+        ts = jnp.where(dinc, t, ts)
+        dadd = recv_from & ~known_pb & ~self_mask
+        hb = jnp.where(dadd, 1, hb)
+        ts = jnp.where(dadd, t, ts)
+        known = exists | padd | dadd
+
+        # ---- JOINREQ at the introducer -----------------------------
+        intro_row = known[INTRODUCER:INTRODUCER + 1, :]  # (1, N)
+        jreq_t = jnp.transpose(jreq.astype(i32)) > 0     # (1, N)
+        qadd = jreq_t & ~intro_row & ~intro_col
+        q_cell = is_intro & qadd                         # (N, N)
+        known = known | q_cell
+        hb = jnp.where(q_cell, 1, hb)
+        ts = jnp.where(q_cell, t, ts)
+
+        # ---- JOINREP at the joiner ---------------------------------
+        radd = jrep & ~known[:, INTRODUCER:INTRODUCER + 1]
+        r_cell = radd & intro_col
+        known = known | r_cell
+        hb = jnp.where(r_cell, 1, hb)
+        ts = jnp.where(r_cell, t, ts)
+
+        # ---- detection + dissemination -----------------------------
+        stale = ops & known & (t - ts >= t_remove)
+        known = known & ~stale
+        send = ops & known
+        gossip_sent = send & ~gdrop
+        live_hold_t = jnp.transpose(live_hold.astype(i32)) > 0   # (1, N)
+        gossip_next = gossip_sent | (gossip_b & live_hold_t)
+        joinreq_next = joinreq_sent | (joinreq0 & ~proc0 & ~failed0)
+        joinrep_next = joinrep_sent | (joinrep0 & live_hold)
+
+        # ---- accounting (EmulNet.cpp:111,172) ----------------------
+        rep_total = joinrep_sent.astype(i32).sum(0, keepdims=True) \
+            .sum(1, keepdims=True)                       # (1, 1)
+        req_total = jreq.astype(i32).sum(0, keepdims=True) \
+            .sum(1, keepdims=True)
+        sent_row = gossip_sent.astype(i32).sum(1, keepdims=True) \
+            + joinreq_sent.astype(i32) \
+            + jnp.where(is_intro, rep_total, 0)
+        recv_row = recv_from.astype(i32).sum(1, keepdims=True) \
+            + jrep.astype(i32) \
+            + jnp.where(is_intro, req_total, 0)
+        sent_o[pl.ds(s, 1), :] = jnp.transpose(sent_row)
+        recv_o[pl.ds(s, 1), :] = jnp.transpose(recv_row)
+
+        # ---- write the end-of-tick state ---------------------------
+        known_o[:] = known.astype(i32)
+        hb_o[:] = hb
+        ts_o[:] = ts
+        gossip_o[:] = gossip_next.astype(i32)
+        aux_o[:] = jnp.concatenate(
+            [in_group.astype(i32), own_hb,
+             joinreq_next.astype(i32), joinrep_next.astype(i32),
+             aux[:, _START:]], axis=1)
+        return ()
+
+    jax.lax.fori_loop(0, s_ticks, tick, (), unroll=False)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n", "s_ticks", "t_remove",
+                                    "can_rejoin", "interpret"))
+def dense_mega_ticks(known, hb, ts, gossip, aux, gdrop, qdrop, pdrop,
+                     sp, *, n: int, s_ticks: int, t_remove: int,
+                     can_rejoin: bool, interpret: bool | None = None):
+    """Run ``s_ticks`` whole dense ticks in one Pallas launch.
+
+    Args:
+      known/hb/ts/gossip: i32[N, N] state planes (bools as 0/1).
+      aux: i32[N, 8] — [in_group, own_hb, joinreq, joinrep, start,
+        fail, rejoin, pad] (see lane constants).
+      gdrop: bool[S, N, N]; qdrop/pdrop: bool[S, N] — the launch's
+        drop decisions, precomputed with ops/drop.py's exact streams.
+      sp: i32[1] — [t0].
+
+    Returns ``(known', hb', ts', gossip', aux', sent i32[S, N],
+    recv i32[S, N])``.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    assert known.shape == (n, n) and n % 8 == 0
+    i32 = jnp.int32
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 8,
+        out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 7,
+        scratch_shapes=[pltpu.VMEM((n, n), i32),
+                        pltpu.VMEM((n, n), i32),
+                        pltpu.VMEM((8, n), i32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, n, s_ticks, t_remove, can_rejoin),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((n, n), i32),
+                   jax.ShapeDtypeStruct((n, n), i32),
+                   jax.ShapeDtypeStruct((n, n), i32),
+                   jax.ShapeDtypeStruct((n, n), i32),
+                   jax.ShapeDtypeStruct((n, DENSE_AUX_LANES), i32),
+                   jax.ShapeDtypeStruct((s_ticks, n), i32),
+                   jax.ShapeDtypeStruct((s_ticks, n), i32)],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=110 * 1024 * 1024),
+        interpret=interpret,
+    )(sp, known, hb, ts, gossip, aux, gdrop, qdrop, pdrop)
+    return out

@@ -1,0 +1,81 @@
+"""Differential tests: dense megakernel vs the per-tick XLA bench run.
+
+The dense megakernel (ops/pallas/dense_mega.py + core/dense_mega.py)
+must replay the per-tick path's exact trajectory — final WorldState
+bit-identical, per-tick sent/recv counters identical — across join
+ramp, single/multi failure, the drop window, and churn.  On CPU the
+kernel runs in interpret mode; compiled TPU runs are exercised by
+bench.py's validated dense configs.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from gossip_protocol_tpu.config import SimConfig
+from gossip_protocol_tpu.core.dense_mega import (dense_mega_supported,
+                                                 make_dense_mega_run)
+from gossip_protocol_tpu.core.tick import make_run
+from gossip_protocol_tpu.state import init_state, make_schedule
+
+STATE_FIELDS = ("tick", "in_group", "own_hb", "known", "hb", "ts",
+                "gossip", "joinreq", "joinrep")
+
+
+def _cfg(scenario, n=64):
+    if scenario == "single":
+        return SimConfig(max_nnb=n, single_failure=True, drop_msg=False,
+                         seed=3, total_ticks=120, fail_tick=40)
+    if scenario == "multi":
+        return SimConfig(max_nnb=n, single_failure=False, drop_msg=False,
+                         seed=5, total_ticks=120, fail_tick=50)
+    if scenario == "drop":
+        return SimConfig(max_nnb=n, single_failure=True, drop_msg=True,
+                         msg_drop_prob=0.25, seed=7, total_ticks=120,
+                         fail_tick=60, drop_open_tick=10,
+                         drop_close_tick=100)
+    if scenario == "churn":
+        return SimConfig(max_nnb=n, single_failure=True, drop_msg=False,
+                         seed=9, total_ticks=120, fail_tick=30,
+                         rejoin_after=25)
+    raise ValueError(scenario)
+
+
+@pytest.mark.parametrize("scenario", ["single", "multi", "drop", "churn"])
+def test_dense_megakernel_bitwise_equals_xla(scenario):
+    cfg = _cfg(scenario)
+    sched = make_schedule(cfg)
+    state = init_state(cfg)
+    run_x = make_run(cfg, with_events=False, use_pallas=False)
+    run_m = make_dense_mega_run(cfg)
+    fx, ex = run_x(state, sched)
+    fm, em = run_m(state, sched)
+    for name in STATE_FIELDS:
+        a, b = np.asarray(getattr(fx, name)), np.asarray(getattr(fm, name))
+        assert np.array_equal(a, b), f"state field {name} diverged"
+    for name in ("sent", "recv"):
+        a, b = np.asarray(getattr(ex, name)), np.asarray(getattr(em, name))
+        assert np.array_equal(a, b), \
+            f"{name} diverged at ticks {np.flatnonzero((a != b).any(1))[:5]}"
+
+
+def test_dense_megakernel_odd_length_chunks():
+    """total_ticks not a multiple of DENSE_MEGA_TICKS exercises the
+    remainder launch."""
+    cfg = _cfg("single").replace(total_ticks=39)
+    sched = make_schedule(cfg)
+    state = init_state(cfg)
+    fx, ex = make_run(cfg, with_events=False, use_pallas=False)(state, sched)
+    fm, em = make_dense_mega_run(cfg)(state, sched)
+    for name in STATE_FIELDS:
+        assert np.array_equal(np.asarray(getattr(fx, name)),
+                              np.asarray(getattr(fm, name))), name
+    assert np.array_equal(np.asarray(ex.sent), np.asarray(em.sent))
+
+
+def test_dense_mega_envelope():
+    assert dense_mega_supported(_cfg("single", 64))
+    assert dense_mega_supported(_cfg("single", 512))
+    assert not dense_mega_supported(
+        SimConfig(max_nnb=1024, single_failure=True, drop_msg=False,
+                  total_ticks=50))
