@@ -33,9 +33,12 @@ from ..utils.hash32 import mix32
 from .overlay import (_SALT_DEGREE, OverlayMetrics, OverlaySchedule,
                       OverlayState, _pack_th, exchange_mask, resolved_dims)
 
-#: VMEM budget bound: three (N, <=128-lane) planes plus merge
-#: temporaries must fit the ~16 MB scoped budget
-MEGA_N_LIMIT = 8192
+#: the envelope verified on hardware: N=4096 (K=48, F<=7) compiles
+#: and runs within the raised scoped-vmem window.  N=8192 nominally
+#: fits the same budget math but was never verified on-chip (the
+#: verification run wedged the relay), so configs above 4096 take the
+#: per-tick fused path instead of risking a runtime VMEM failure.
+MEGA_N_LIMIT = 4096
 
 
 def mega_supported(cfg: SimConfig) -> bool:

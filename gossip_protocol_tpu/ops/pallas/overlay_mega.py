@@ -41,9 +41,9 @@ ticks per launch with the entire world state resident in VMEM:
   so the megakernel is a pure scheduling optimization.
 
 Scope: single-device, power-of-two N with 2*K+16 <= 128 and
-N <= MEGA_N_LIMIT.  Larger N keeps the per-tick fused kernel
-(overlay_exchange.py); the sharded mesh path keeps the XLA
-formulation.
+N <= MEGA_N_LIMIT (the hardware-verified envelope).  Larger N keeps
+the per-tick fused kernel (overlay_exchange.py); the sharded mesh
+path uses that kernel under shard_map.
 
 The per-tick metric ``live_uncovered`` needs a cross-peer histogram
 the kernel does not compute; the megakernel path reports -1 (the
