@@ -37,18 +37,30 @@ hundreds of ms per tick at 65k), so every phase here is dense algebra:
   work).  At each SLOT_EPOCH boundary every node re-slots its own
   table once (an O(K²) contention pass amortized over SLOT_EPOCH
   ticks, skipped on all other ticks via ``lax.cond``).
-* **Contention is rotated, per receiver.**  Slot collisions (ids with
+* **Contention is freshness-majorized.**  Slot collisions (ids with
   equal ``g_e``) contend; the winner is the largest packed uint32
-  key — freshness band first, then an **epoch-rotated per-receiver
-  tiebreak** (``mix32(t // EPOCH, receiver, id)``).  The per-receiver
-  tie is load-bearing twice over: it keeps view composition
-  reshuffling (the TPU-shaped analog of Cyclon view exchange), and it
-  decorrelates the *global* slot collisions — colliding ids win at
-  different receivers, so every live id keeps holders somewhere, and
-  the SLOT_EPOCH re-roll retires any collision pair after at most one
-  epoch.
+  key ``(ts+1) << ID_BITS | id`` — the freshest observation wins
+  outright, ties break on id.  The key is a pure function of the
+  stored entry (no per-receiver or per-tick hash), which makes the
+  whole merge pipeline single-uint-compare cheap — the VPU-bound
+  in-kernel tick cost is dominated by per-entry key work, and this
+  design removes all of it (round-5 redesign; the earlier
+  epoch-rotated per-receiver tiebreak spent ~2x the vector ops for
+  the same guarantees).  Coverage under deterministic contention is
+  held **structurally** by the self-reseed: every live node stamps
+  ``(id, own_hb, t-1)`` directly at its F partners each tick, and a
+  tick-(t-1) observation carries the maximum timestamp any *relayed
+  table entry* can have at tick t — so a direct self-entry outranks
+  relayed rivals up to rare equal-ts ties (another direct entry, or a
+  relayed JOINREQ entry stamped ts=t one tick earlier, colliding in
+  the same slot with a larger id).  Every live member therefore keeps
+  fresh holders at its (per-tick re-randomized) partners nearly every
+  tick; the hard guarantee is the re-cover bound — the re-seeding
+  plus the SLOT_EPOCH re-roll re-cover any transient hole within
+  ``SLOT_EPOCH + 1`` ticks (tests/test_overlay.py::test_recover_bound;
+  asserted at 65k scale by bench.py's boundary coverage walk).
 * **Freshness is the priority.**  A live node stamps its own entry
-  ``(id, own_hb, now)`` into every payload; the banded max-merge
+  ``(id, own_hb, now)`` into every payload; the freshness-keyed merge
   propagates the freshest observation along exchange paths, so an
   entry's ``ts`` is the newest time anyone in the path cone saw the
   subject alive.  Failure detection is the reference's staleness rule
@@ -92,17 +104,13 @@ from ..config import INTRODUCER, SimConfig
 from ..state import NEVER
 from ..utils.hash32 import mix32, threshold32
 
-#: id field width in the packed priority key: ids + 1 <= 2^21 - 1, and
-#: the XOR exchange needs a power-of-two peer count, so the largest
-#: supported group is N = 2^20 = 1,048,576 — the BASELINE 1M-peer
-#: config exactly.
-ID_BITS = 21
+#: id field width in the packed priority key: ids < 2^20, and the XOR
+#: exchange needs a power-of-two peer count, so the largest supported
+#: group is N = 2^20 = 1,048,576 — the BASELINE 1M-peer config
+#: exactly.  With the 12-bit ts+1 field (runs cap at 4094 ticks) the
+#: key fills the uint32 exactly.
+ID_BITS = 20
 ID_MASK = (1 << ID_BITS) - 1
-
-#: freshness band width (ticks) and tiebreak rotation period
-BAND = 4
-EPOCH = 4
-_TIE_BITS = 8
 
 #: global slot map re-roll period (ticks).  Long enough to amortize the
 #: O(K²) re-slot pass, short enough that a slot collision between two
@@ -372,58 +380,35 @@ def _slot_of(seed, slot_epoch_u, ids, k):
 
     The map is shared by every node (NOT receiver-hashed) and re-rolled
     every SLOT_EPOCH ticks, so identically-slotted tables merge
-    lane-aligned; per-receiver diversity lives in the key's tie field.
+    lane-aligned and any persistent slot collision is retired within
+    one epoch.
     """
     return (mix32(seed, slot_epoch_u, ids.astype(jnp.uint32),
                   np.uint32(_SALT_SLOT)) % k).astype(jnp.int32)
 
 
-def _pack_key(seed, t, rows_u, ids, ts):
-    """uint32 slot-priority key: freshness band | rotated tie | id+1.
+def _pack_key(ids, ts):
+    """uint32 slot-priority key: freshness-majorized.
 
-    band (3b, bits 29-31): fresher BAND-quantized age wins outright.
-    tie (_TIE_BITS=8b, bits 21-28): mix32(seed, epoch, receiver, id) —
-               re-rolled every EPOCH ticks, per receiver, so slot
-               winners rotate.
-    id+1 (ID_BITS=21b, bits 0-20): deterministic final tiebreak;
-               nonzero (0 = empty).
+    ``(ts+1) << ID_BITS | id`` — the freshest observation wins a slot
+    outright; equal timestamps break on the larger id (deterministic,
+    receiver-independent).  A pure function of the stored entry with
+    no per-tick hashing: the merge pipeline reduces to single uint32
+    compares, which is what makes the in-kernel tick cheap (module
+    docstring).  0 is the empty key (real entries have ts >= 0, so
+    their keys are >= 1 << ID_BITS).
+
+    Direct observations need no boost field: a subject's own
+    self-entry (the partner / introducer-reply entry, age 1) or its
+    JOINREQ (age 0) carries the maximum timestamp any relayed table
+    entry can have at merge time — relayed tables were frozen one tick
+    earlier — so direct entries outrank relayed rivals except for rare
+    equal-ts ties (see the module docstring), which is what keeps
+    every live member covered under deterministic contention up to the
+    SLOT_EPOCH + 1 re-cover bound.
     """
-    age = jnp.clip(t - ts, 0, 8 * BAND - 1)
-    band = (jnp.uint32(7) - (age // BAND).astype(jnp.uint32)) \
-        << (ID_BITS + _TIE_BITS)
-    epoch = (t // EPOCH).astype(jnp.uint32)
-    # the tie is the hash's top _TIE_BITS placed at bit ID_BITS — mask
-    # then one right shift, NOT (h >> 24) << 21: that shift pair
-    # miscompiles under Mosaic in the fused kernel's context (observed
-    # on v5e: small tie values land as 0), and the masked form is
-    # bit-identical algebra
-    tie_mask = jnp.uint32(((1 << _TIE_BITS) - 1) << (32 - _TIE_BITS))
-    tie = (mix32(seed, epoch, rows_u, ids.astype(jnp.uint32))
-           & tie_mask) >> (32 - _TIE_BITS - ID_BITS)
-    return band | tie | (ids + 1).astype(jnp.uint32)
-
-
-#: saturated tie field — see _pack_key_direct
-_TIE_MAX = ((1 << _TIE_BITS) - 1) << ID_BITS
-
-
-def _pack_key_direct(t, ids, ts):
-    """Key of a DIRECT observation: a subject's own self-entry (the
-    partner / introducer-reply entry) or its JOINREQ.
-
-    The tie field is saturated, so a direct entry outranks every
-    same-band hashed-tie rival: each live sender deterministically
-    (re)seeds itself at its F partners every tick, which closes the
-    transient union-coverage gaps that receiver-rotated contention
-    alone leaves open (a hashed tie can lose a slot at every current
-    holder simultaneously for a few ticks).  The boost exists only at
-    candidate time — once stored, the entry is ranked by the normal
-    hashed key, so slots do not freeze.
-    """
-    age = jnp.clip(t - ts, 0, 8 * BAND - 1)
-    band = (jnp.uint32(7) - (age // BAND).astype(jnp.uint32)) \
-        << (ID_BITS + _TIE_BITS)
-    return band | jnp.uint32(_TIE_MAX) | (ids + 1).astype(jnp.uint32)
+    return ((ts + 1).astype(jnp.uint32) << ID_BITS) \
+        | ids.astype(jnp.uint32)
 
 
 class LocalOverlayComm:
@@ -492,8 +477,8 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
     t_remove = cfg.t_remove
     assert n & (n - 1) == 0, "overlay peer count must be a power of two " \
         "(XOR partner exchange)"
-    assert n + 1 < (1 << ID_BITS), \
-        f"overlay supports N <= {1 << (ID_BITS - 1)}"
+    assert n <= (1 << ID_BITS), \
+        f"overlay supports N <= {1 << ID_BITS}"
     assert cfg.total_ticks <= 4094, \
         "the packed (ts, hb) winner payload caps runs at 4094 ticks " \
         "(the reference caps at MAX_TIME 3600, EmulNet.h:11)"
@@ -609,8 +594,7 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
         # (K, N) one-hot max (addMember, MP1Node.cpp:265-280)
         q_slot = _slot_of(seed, slot_ep, rows, k)
         q_key = jnp.where(jreq & ~intro_onehot,
-                          _pack_key_direct(t, rows,
-                                           jnp.broadcast_to(t, (n,))), 0)
+                          _pack_key(rows, jnp.broadcast_to(t, (n,))), 0)
         q_match = q_slot[None, :] == kk[:, None]             # (K, N)
         q_kf = (q_match * q_key[None, :]).max(1)             # (K,)
         q_sel = q_match & (q_key[None, :] == q_kf[:, None]) & (q_kf > 0)[:, None]
@@ -698,10 +682,7 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
             # capped at 4094 ticks); among equal-priority-key
             # candidates the lexicographic (ts, hb) max wins, which
             # the oracle mirrors.
-            cur_key = jnp.where(ids0 >= 0,
-                                _pack_key(seed, t, rows_u[:, None],
-                                          ids0, ts0),
-                                0)
+            cur_key = jnp.where(ids0 >= 0, _pack_key(ids0, ts0), 0)
             keymax = cur_key
             p_acc = p0
             # zero derived from a shard-local value so the exchange
@@ -720,9 +701,7 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
 
                 ``c_p`` is the already-packed (ts, hb) payload word —
                 the wire format and the merge tiebreak coincide."""
-                key = jnp.where(valid,
-                                _pack_key(seed, t, rows_u[:, None],
-                                          c_id, c_ts),
+                key = jnp.where(valid, _pack_key(c_id, c_ts),
                                 jnp.uint32(0))
                 return lex_merge(keymax, p_acc, key,
                                  jnp.where(valid, c_p, 0))
@@ -730,7 +709,7 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
             def entry_merge(keymax, p_acc, subj, e_ts, e_hb, ok):
                 """Merge one DIRECT (subject, ts, hb) entry per row."""
                 sl = _slot_of(seed, slot_ep, subj, k)
-                key = jnp.where(ok, _pack_key_direct(t, subj, e_ts),
+                key = jnp.where(ok, _pack_key(subj, e_ts),
                                 jnp.uint32(0))
                 p = jnp.where(ok, _pack_th(e_ts, e_hb), 0)
                 match = sl[:, None] == kk[None, :]
@@ -806,7 +785,7 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
             recv_cnt += joins_recv
 
             ids1 = jnp.where(keymax > 0,
-                             (keymax & ID_MASK).astype(jnp.int32) - 1, -1)
+                             (keymax & ID_MASK).astype(jnp.int32), -1)
             ts1 = jnp.where(keymax > 0, (p_acc >> 12) - 1, 0)
             hb1 = jnp.where(keymax > 0, (p_acc & 0xFFF) - 1, 0)
 
@@ -853,8 +832,7 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
         def reslot(tabs):
             idsv, hbv, tsv = tabs
             tgt = _slot_of(seed, next_ep, idsv, k)           # (Nl, K)
-            key = jnp.where(idsv >= 0,
-                            _pack_key(seed, t, rows_u[:, None], idsv, tsv),
+            key = jnp.where(idsv >= 0, _pack_key(idsv, tsv),
                             jnp.uint32(0))
             p = jnp.where(idsv >= 0, _pack_th(tsv, hbv), 0)
 
@@ -875,7 +853,7 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
                 kf, pf = jax.lax.map(block, (shp(tgt), shp(key), shp(p)))
                 kf = kf.reshape(nl, k)
                 pf = pf.reshape(nl, k)
-            return (jnp.where(kf > 0, (kf & ID_MASK).astype(jnp.int32) - 1,
+            return (jnp.where(kf > 0, (kf & ID_MASK).astype(jnp.int32),
                               -1),
                     jnp.where(kf > 0, (pf & 0xFFF) - 1, 0),
                     jnp.where(kf > 0, (pf >> 12) - 1, 0))
@@ -939,6 +917,47 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
     return tick
 
 
+def covered_histogram(ids, n: int, chunk: int = 1 << 15):
+    """bool[N]: which subject ids appear in at least one view slot.
+
+    Scatter-free (SURVEY: gather/scatter serialize at ~75M indices/s on
+    this TPU): the presence histogram is computed as a blocked int8
+    one-hot matmul — split each id j into (j >> 8, j & 255) and count
+    entries per (hi, lo) bin pair with an int8 MXU contraction (exact:
+    i8 x i8 accumulates in i32).  O(N*K*(N/256 + 256)) int8 work, ~2 GB
+    of one-hot traffic at N=65536 — cheap enough to sample at launch
+    boundaries during validation, far cheaper than the 4.2M-index
+    scatter it replaces.  Intended for N <= ~2^17; the 1M config keeps
+    final-snapshot validation (bench.py)."""
+    assert n & (n - 1) == 0 and n >= 256, n
+    c = 256
+    r = n // c
+    e = ids.reshape(-1)
+    pad = (-e.shape[0]) % chunk
+    if pad:
+        e = jnp.concatenate([e, jnp.full((pad,), -1, e.dtype)])
+    valid = e >= 0
+    ei = jnp.where(valid, e, 0)
+    hs = (ei >> 8).reshape(-1, chunk)
+    ls = (ei & 255).reshape(-1, chunk)
+    vs = valid.reshape(-1, chunk)
+    iota_r = jnp.arange(r, dtype=jnp.int32)[None, :]
+    iota_c = jnp.arange(c, dtype=jnp.int32)[None, :]
+
+    def step(acc, args):
+        h, l, v = args
+        oh_h = ((h[:, None] == iota_r) & v[:, None]).astype(jnp.int8)
+        oh_l = (l[:, None] == iota_c).astype(jnp.int8)
+        acc = acc + jax.lax.dot_general(
+            oh_h, oh_l, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        return acc, None
+
+    acc, _ = jax.lax.scan(step, jnp.zeros((r, c), jnp.int32),
+                          (hs, ls, vs))
+    return (acc > 0).reshape(n)
+
+
 _OVERLAY_RUN_CACHE: dict = {}
 
 
@@ -959,15 +978,25 @@ def make_overlay_run(cfg: SimConfig, length: int | None = None,
     length = cfg.total_ticks if length is None else length
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
+    from .overlay_grid import grid_supported, make_grid_run
     from .overlay_mega import make_mega_run, mega_supported
     mega = bool(use_pallas) and mega_supported(cfg)
+    # above the VMEM-megakernel envelope the grid-scale multi-tick
+    # kernel takes over (HBM-resident double-buffered state, TPU only:
+    # the eager interpret-mode launch sequence is for tests)
+    grid = (bool(use_pallas) and not mega and grid_supported(cfg)
+            and jax.default_backend() == "tpu")
     key = (cfg.n, cfg.t_remove, length, resolved_dims(cfg), use_pallas,
-           cfg.topology, cfg.total_ticks, mega,
+           cfg.topology, cfg.total_ticks, mega, grid,
            cfg.churn_rate > 0 or cfg.rejoin_after is not None)
     if key in _OVERLAY_RUN_CACHE:
         return _OVERLAY_RUN_CACHE[key]
     if mega:
         run = make_mega_run(cfg, length)
+        _OVERLAY_RUN_CACHE[key] = run
+        return run
+    if grid:
+        run = make_grid_run(cfg, length)
         _OVERLAY_RUN_CACHE[key] = run
         return run
     tick = make_overlay_tick(cfg, use_pallas=use_pallas)

@@ -1,0 +1,237 @@
+"""Host harness for the grid-scale multi-tick overlay megakernel.
+
+Packs the :class:`~.overlay.OverlayState` pytree into the kernel's
+single (N, 2K) plane (ids | payload words with the aux bytes riding
+the spare high bytes — ops/pallas/overlay_grid.py), runs ``lax.scan``
+over whole-``GRID_TICKS`` launches, and unpacks the result into the
+same ``(final_state, OverlayMetrics[T])`` contract as
+:func:`~.overlay.make_overlay_run` — a drop-in scheduling optimization
+for N above the VMEM megakernel envelope, bit-identical to the XLA
+tick (tests/test_overlay_grid.py).
+
+Why it exists: above ``MEGA_N_LIMIT`` the per-tick formulation pays a
+fixed ~300-450 us Pallas launch plus an ~0.5-11.7 ms tail of per-tick
+XLA vector phases every tick (docs/PERF.md) — the fixed cost the
+reference's per-tick hot loop does not have
+(/root/reference/Application.cpp:99-163).  Running ``GRID_TICKS``
+whole ticks per launch with double-buffered HBM state amortizes the
+launch floor and eliminates the XLA tail entirely.
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import INTRODUCER, SimConfig
+from ..ops.pallas.overlay_grid import (GRID_BLOCK_ROWS, GRID_TICKS,
+                                       MET_ADDS, MET_FALSE_REMOVALS,
+                                       MET_IN_GROUP, MET_RECV,
+                                       MET_REMOVALS, MET_SENT, MET_VICTIM,
+                                       MET_VIEW, grid_overlay_ticks,
+                                       pack_aux_lanes, unpack_aux_lanes)
+from .overlay import (SLOT_EPOCH, OverlayMetrics, OverlaySchedule,
+                      OverlayState, _pack_key, _pack_th, _slot_of,
+                      exchange_mask, resolved_dims)
+
+
+def _step_frac(cfg: SimConfig):
+    frac = Fraction(cfg.step_rate).limit_denominator(1 << 15)
+    return frac.numerator, max(frac.denominator, 1)
+
+
+def grid_supported(cfg: SimConfig) -> bool:
+    """Whether the grid-scale multi-tick kernel covers this config.
+
+    The envelope is structural, not VMEM-bound: only row blocks live
+    on-chip, so any power-of-two N >= 8 with a 2K <= 128-lane packed
+    plane qualifies.  ``step_num * (N-1) < 2^31`` guards the kernel's
+    division-free start-ramp comparisons (module docstring)."""
+    from .overlay import ID_BITS
+    n = cfg.n
+    k, f = resolved_dims(cfg)
+    num, _ = _step_frac(cfg)
+    return (cfg.model == "overlay" and n & (n - 1) == 0 and n >= 8
+            and n <= (1 << ID_BITS)      # id field of the packed key
+            and 2 * k <= 128 and k >= 8 and f <= 8
+            and cfg.total_ticks <= 4094
+            and num * (n - 1) < 2 ** 31)
+
+
+def pack_grid_plane(cfg: SimConfig, state: OverlayState):
+    """OverlayState -> the packed (N, PLANE_W) plane."""
+    from ..ops.pallas.overlay_grid import PLANE_W
+    n = cfg.n
+    k, f = resolved_dims(cfg)
+    i32 = jnp.int32
+    pw = jnp.where(state.ids >= 0, _pack_th(state.ts, state.hb), 0)
+    fis = jnp.arange(f, dtype=i32)[None, :]
+    sf_bits = (state.send_flags.astype(i32) << fis).sum(1, keepdims=True)
+    pw = pack_aux_lanes(pw, state.own_hb[:, None],
+                        state.in_group.astype(i32)[:, None],
+                        state.joinreq.astype(i32)[:, None],
+                        state.joinrep.astype(i32)[:, None], sf_bits)
+    cols = [state.ids, pw]
+    if 2 * k < PLANE_W:
+        cols.append(jnp.zeros((n, PLANE_W - 2 * k), i32))
+    return jnp.concatenate(cols, axis=1)
+
+
+def unpack_grid_plane(cfg: SimConfig, plane, tick) -> OverlayState:
+    k, f = resolved_dims(cfg)
+    ids = plane[:, 0:k]
+    pw, own_hb, a1, sf = unpack_aux_lanes(plane[:, k:2 * k])
+    occ = ids >= 0
+    fis = jnp.arange(f, dtype=jnp.int32)[None, :]
+    return OverlayState(
+        tick=tick.astype(jnp.int32),
+        ids=ids,
+        hb=jnp.where(occ, (pw & 0xFFF) - 1, 0),
+        ts=jnp.where(occ, (pw >> 12) - 1, 0),
+        in_group=(a1[:, 0] & 0x10) > 0,
+        own_hb=own_hb[:, 0],
+        send_flags=((sf >> fis) & 1) > 0,
+        joinreq=(a1[:, 0] & 0x20) > 0,
+        joinrep=(a1[:, 0] & 0x40) > 0,
+    )
+
+
+def _boot_rows(cfg: SimConfig, sched: OverlaySchedule, plane, t0):
+    """The (8, 2K) boot block: row 0 the introducer's plane row, row 1
+    the start tick's JOINREQ per-slot aggregate (computed once per
+    launch in XLA; later ticks' aggregates accumulate in-kernel)."""
+    n = cfg.n
+    k, _ = resolved_dims(cfg)
+    rows = jnp.arange(n, dtype=jnp.int32)
+    a1 = (plane[:, k + 1] >> 24) & 0xFF
+    joinreq = (a1 & 0x20) > 0
+    intro = jnp.int32(INTRODUCER)
+    fail0 = sched.fail_of(intro)
+    rejoin0 = sched.rejoin_of(intro)
+    proc0 = (t0 > 0) & ~((t0 > fail0) & (t0 <= rejoin0))
+    jreq = joinreq & proc0
+    slot_ep = (t0 // SLOT_EPOCH).astype(jnp.uint32)
+    q_slot = _slot_of(sched.seed, slot_ep, rows, k)
+    q_key = jnp.where(jreq & (rows != INTRODUCER),
+                      _pack_key(rows, jnp.broadcast_to(t0, (n,))),
+                      jnp.uint32(0))
+    kk = jnp.arange(k, dtype=jnp.int32)
+    q_kf = jnp.where(q_slot[None, :] == kk[:, None],
+                     q_key[None, :], jnp.uint32(0)).max(1)
+    from ..ops.pallas.overlay_grid import PLANE_W
+    boot = jnp.zeros((8, PLANE_W), jnp.int32)
+    boot = boot.at[0].set(plane[INTRODUCER])
+    boot = boot.at[1, 0:k].set(q_kf.astype(jnp.int32))
+    return boot
+
+
+def _sp_vector(sched: OverlaySchedule, t0, s_ticks: int, n: int, f: int):
+    i32 = jnp.int32
+    intro = jnp.int32(INTRODUCER)
+    scalars = jnp.stack([
+        t0.astype(i32) if hasattr(t0, "astype") else jnp.int32(t0),
+        sched.seed.astype(i32), sched.victim_lo, sched.victim_hi,
+        sched.fail_tick, sched.rejoin_after,
+        sched.churn_thr.astype(i32), sched.churn_after,
+        sched.drop_on.astype(i32), sched.drop_open, sched.drop_close,
+        sched.drop_thr.astype(i32),
+        sched.fail_of(intro), sched.rejoin_of(intro),
+        sched.step_num, sched.step_den,
+    ])
+    deg = jnp.asarray(sched.deg_thr).astype(i32)[:f - 1]
+    ts = t0 + jnp.arange(s_ticks, dtype=i32)
+    masks = jnp.stack([exchange_mask(sched.seed, ts - 1, fi, n)
+                       for fi in range(f)], axis=1)        # (S, F)
+    return jnp.concatenate([scalars, deg, masks.reshape(-1)])
+
+
+def make_grid_run(cfg: SimConfig, length: int,
+                  block_rows: int = GRID_BLOCK_ROWS):
+    """``run(state, sched) -> (final, OverlayMetrics[length])`` via
+    whole-``GRID_TICKS`` grid-kernel launches (same contract as
+    :func:`~.overlay.make_overlay_run`).
+
+    On TPU the launches run inside one jitted ``lax.scan``; on other
+    backends each launch dispatches eagerly (inlining interpret-mode
+    kernels into a jitted scan blows up the XLA:CPU compile — see
+    overlay_mega.make_mega_run)."""
+    assert grid_supported(cfg), "config outside the grid-kernel envelope"
+    n = cfg.n
+    k, f = resolved_dims(cfg)
+    b = min(block_rows, n)
+    n_chunks, rem = divmod(length, GRID_TICKS)
+    kern_kw = dict(n=n, k=k, f_rounds=f, b=b, t_remove=cfg.t_remove,
+                   churn_lo=cfg.total_ticks // 4,
+                   churn_span=max(cfg.total_ticks // 2, 1),
+                   can_rejoin=cfg.churn_rate > 0
+                   or cfg.rejoin_after is not None,
+                   powerlaw=cfg.topology == "powerlaw")
+
+    def _metrics(met):
+        return OverlayMetrics(
+            in_group=met[:, MET_IN_GROUP],
+            view_slots=met[:, MET_VIEW],
+            adds=met[:, MET_ADDS],
+            removals=met[:, MET_REMOVALS],
+            false_removals=met[:, MET_FALSE_REMOVALS],
+            victim_slots=met[:, MET_VICTIM],
+            live_uncovered=jnp.full((length,), -1, jnp.int32),
+            sent=met[:, MET_SENT],
+            recv=met[:, MET_RECV],
+        )
+
+    def launch(plane, t, sched, s_ticks: int):
+        init = jnp.concatenate([plane, _boot_rows(cfg, sched, plane, t)],
+                               axis=0)
+        sp = _sp_vector(sched, t, s_ticks, n, f)
+        plane2, met = grid_overlay_ticks(init, sp, s_ticks=s_ticks,
+                                         **kern_kw)
+        return plane2[s_ticks % 2], t + s_ticks, met
+
+    def assemble(plane, t, met_parts):
+        met = jnp.concatenate(met_parts, axis=0) if met_parts \
+            else jnp.zeros((0, 128), jnp.int32)
+        return unpack_grid_plane(cfg, plane, t), _metrics(met)
+
+    def run_body(state: OverlayState, sched: OverlaySchedule):
+        plane = pack_grid_plane(cfg, state)
+        t = state.tick
+        met_parts = []
+        if n_chunks:
+            def step(carry, _):
+                plane, t, met = launch(carry[0], carry[1], sched,
+                                       GRID_TICKS)
+                return (plane, t), met
+            (plane, t), met_main = jax.lax.scan(
+                step, (plane, t), None, length=n_chunks)
+            met_parts.append(met_main.reshape(n_chunks * GRID_TICKS, 128))
+        if rem:
+            plane, t, met_rem = launch(plane, t, sched, rem)
+            met_parts.append(met_rem)
+        return assemble(plane, t, met_parts)
+
+    if jax.default_backend() == "tpu":
+        # the ANY-space double-buffered plane is XLA-placed: at mid N
+        # (e.g. 8192 -> 8 MB) XLA puts it in VMEM, which overflows the
+        # default 16 MB scoped window together with the kernel's row
+        # blocks; v5e has 128 MB of physical VMEM (at large N XLA
+        # falls back to HBM on its own)
+        return jax.jit(run_body, compiler_options={
+            "xla_tpu_scoped_vmem_limit_kib": "98304"})
+
+    def run_eager(state: OverlayState, sched: OverlaySchedule):
+        plane = pack_grid_plane(cfg, state)
+        t = state.tick
+        met_parts = []
+        for _ in range(n_chunks):
+            plane, t, met = launch(plane, t, sched, GRID_TICKS)
+            met_parts.append(met)
+        if rem:
+            plane, t, met = launch(plane, t, sched, rem)
+            met_parts.append(met)
+        return assemble(plane, t, met_parts)
+
+    return run_eager

@@ -144,10 +144,15 @@ def make_sharded_overlay_run(cfg: SimConfig, mesh: Mesh,
         body, mesh=mesh,
         in_specs=(_state_specs(axis), _sched_specs()),
         out_specs=(_state_specs(axis), _metric_specs()),
-        # the fused kernel's scalar-prefetch vector mixes shard-varying
-        # (row_start) and replicated scalars, which VMA typing inside
-        # the pallas machinery rejects (jax suggests this exact
-        # workaround); the XLA path keeps the strict check
+        # The XLA path keeps full VMA checking.  The kernel path
+        # cannot, and not because of our typing: the fused kernel's
+        # operands are VMA-consistent (the scalar-prefetch vector is
+        # shard-invariant by construction — the shard-varying
+        # row_start rides a separate SMEM operand), but pallas's own
+        # machinery slices kernel operands with replicated loop
+        # indices (jax pallas hlo_interpreter dynamic_slice), which
+        # trips the check for any shard-varying operand; jax's error
+        # text itself prescribes check_vma=False as the workaround.
         check_vma=not use_pallas,
     )
     run = jax.jit(shmapped)

@@ -41,11 +41,9 @@ Semantics are bit-identical to the XLA phases in models/overlay.py
 lexicographic max is order-free, so fusing the phases cannot change
 any winner).  Differentially tested in tests/test_overlay_pallas.py.
 
-Mosaic workarounds (observed on v5e): ``_pack_key`` must use the
-masked single-shift tie form — the ``(h >> 24) << 21`` shift pair
-miscompiles in large kernel contexts (small tie values land as 0); and
-``jnp.maximum`` on uint32 vectors does not legalize (``arith.maxui``),
-so the lexicographic merge sticks to compare+select.
+Mosaic workarounds (observed on v5e): ``jnp.maximum`` on uint32
+vectors does not legalize (``arith.maxui``), so the lexicographic
+merge sticks to compare+select.
 """
 
 from __future__ import annotations
@@ -75,9 +73,11 @@ def _roll_rows(x, shift: int):
 
 def _kernel(b: int, w_cols: int, k: int, f_rounds: int, t_remove: int,
             churn_lo: int, churn_span: int, never: int,
-            # scalar prefetch: [t, seed, victim_lo, victim_hi,
-            #   fail_tick, rejoin_after, churn_thr, churn_after,
-            #   row_start, mlo_0 .. mlo_{F-1}, m_0 .. m_{F-1}]
+            # scalar prefetch (shard-INVARIANT — index maps are
+            # evaluated with replicated loop indices, so shard-varying
+            # values must not ride here): [t, seed, victim_lo,
+            #   victim_hi, fail_tick, rejoin_after, churn_thr,
+            #   churn_after, mlo_0 .. mlo_{F-1}, m_0 .. m_{F-1}]
             # (mlo = shard-local mask bits for the block index map;
             #  m = the global mask for partner identity — identical
             #  on a single device)
@@ -85,9 +85,9 @@ def _kernel(b: int, w_cols: int, k: int, f_rounds: int, t_remove: int,
             # inputs
             *refs):
     from ...config import INTRODUCER
-    from ...models.overlay import (SLOT_EPOCH, _SALT_CHURN,
+    from ...models.overlay import (ID_MASK, SLOT_EPOCH, _SALT_CHURN,
                                    _SALT_CHURN_TICK, _pack_key,
-                                   _pack_key_direct, _pack_th, _slot_of)
+                                   _pack_th, _slot_of)
     from ...utils.hash32 import mix32
 
     ia_id = refs[0]                     # (B, W) identity idsaux
@@ -95,7 +95,10 @@ def _kernel(b: int, w_cols: int, k: int, f_rounds: int, t_remove: int,
     ia_x = refs[2:2 + f_rounds]         # per-round XOR-mapped idsaux
     pw_x = refs[2 + f_rounds:2 + 2 * f_rounds]
     intro_ref = refs[2 + 2 * f_rounds]  # (8, K) replicated small input
-    ids_out, hb_out, tsc_out, wa_scr, wp_scr = refs[3 + 2 * f_rounds:]
+    rs_ref = refs[3 + 2 * f_rounds]     # SMEM (1,): global id of local
+    #                                     row 0 (shard-varying, so it
+    #                                     cannot ride scalar prefetch)
+    ids_out, hb_out, tsc_out, wa_scr, wp_scr = refs[4 + 2 * f_rounds:]
 
     i_blk = pl.program_id(0)
     t = sp_ref[0]
@@ -106,11 +109,10 @@ def _kernel(b: int, w_cols: int, k: int, f_rounds: int, t_remove: int,
     rejoin_after = sp_ref[5]
     churn_thr = sp_ref[6].astype(jnp.uint32)
     churn_after = sp_ref[7]
-    row_start = sp_ref[8]                          # global id of local row 0
+    row_start = rs_ref[0]
 
     rbits = jax.lax.broadcasted_iota(jnp.int32, (b, 1), 0)
     rows = row_start + i_blk * b + rbits           # (B, 1) global rows
-    rows_u = rows.astype(jnp.uint32)
     kk = jax.lax.broadcasted_iota(jnp.int32, (b, k), 1)
     lgb = b.bit_length() - 1
     slot_ep = (t // SLOT_EPOCH).astype(jnp.uint32)
@@ -124,8 +126,7 @@ def _kernel(b: int, w_cols: int, k: int, f_rounds: int, t_remove: int,
     jrep_r = (bits & 4) > 0
     my_p = jnp.where(my_ids >= 0, pw_id[:], 0)
     my_ts = (my_p >> 12) - 1
-    kmax = jnp.where(my_ids >= 0,
-                     _pack_key(seed, t, rows_u, my_ids, my_ts),
+    kmax = jnp.where(my_ids >= 0, _pack_key(my_ids, my_ts),
                      jnp.uint32(0))
     pacc = my_p
     recv = jnp.zeros((b, 1), jnp.int32)
@@ -137,8 +138,8 @@ def _kernel(b: int, w_cols: int, k: int, f_rounds: int, t_remove: int,
 
     # ---- F exchange rounds -----------------------------------------
     for fi in range(f_rounds):
-        m_lo = sp_ref[9 + fi]                # shard-local mask bits
-        m = sp_ref[9 + f_rounds + fi]        # global mask (partner id)
+        m_lo = sp_ref[8 + fi]                # shard-local mask bits
+        m = sp_ref[8 + f_rounds + fi]        # global mask (partner id)
         # butterfly the local mask's low bits, predicated per bit
         wa_scr[:] = ia_x[fi][:]
         wp_scr[:] = pw_x[fi][:]
@@ -164,7 +165,7 @@ def _kernel(b: int, w_cols: int, k: int, f_rounds: int, t_remove: int,
         in_ts = (in_p >> 12) - 1
         valid = ok & (in_ids >= 0) & (t - in_ts < t_remove) \
             & (in_ids != rows)
-        key = jnp.where(valid, _pack_key(seed, t, rows_u, in_ids, in_ts),
+        key = jnp.where(valid, _pack_key(in_ids, in_ts),
                         jnp.uint32(0))
         kmax, pacc = lex(kmax, pacc, key, jnp.where(valid, in_p, 0))
 
@@ -172,7 +173,7 @@ def _kernel(b: int, w_cols: int, k: int, f_rounds: int, t_remove: int,
             partner = rows ^ m
             psl = _slot_of(seed, slot_ep, partner, k)
             e_ts = jnp.zeros_like(partner) + (t - 1)
-            pkey = jnp.where(ok, _pack_key_direct(t, partner, e_ts),
+            pkey = jnp.where(ok, _pack_key(partner, e_ts),
                              jnp.uint32(0))
             pp = jnp.where(ok, _pack_th(e_ts, wa[:, k:k + 1]), 0)
             match = psl == kk
@@ -187,7 +188,7 @@ def _kernel(b: int, w_cols: int, k: int, f_rounds: int, t_remove: int,
     bc_ts = (bc_p >> 12) - 1
     j_valid = jrep_r & (bc_ids >= 0) & (t - bc_ts < t_remove) \
         & (bc_ids != rows)
-    jkey = jnp.where(j_valid, _pack_key(seed, t, rows_u, bc_ids, bc_ts),
+    jkey = jnp.where(j_valid, _pack_key(bc_ids, bc_ts),
                      jnp.uint32(0))
     kmax, pacc = lex(kmax, pacc, jkey, jnp.where(j_valid, bc_p, 0))
     if t_remove > 1:                     # the introducer's self-entry
@@ -195,7 +196,7 @@ def _kernel(b: int, w_cols: int, k: int, f_rounds: int, t_remove: int,
         islot = _slot_of(seed, slot_ep, intro_vec, k)
         e_ts = jnp.zeros_like(rows) + (t - 1)
         iok = jrep_r & (rows != INTRODUCER)
-        ikey = jnp.where(iok, _pack_key_direct(t, intro_vec, e_ts),
+        ikey = jnp.where(iok, _pack_key(intro_vec, e_ts),
                          jnp.uint32(0))
         ip = jnp.where(iok, _pack_th(e_ts, intro_ref[2:3, 0:1]), 0)
         imatch = islot == kk
@@ -212,8 +213,8 @@ def _kernel(b: int, w_cols: int, k: int, f_rounds: int, t_remove: int,
                      jnp.where(is_r0, q_pf, 0))
 
     # ---- winner extraction + staleness detection -------------------
-    id_mask = jnp.uint32((1 << 21) - 1)              # ID_MASK
-    ids1 = jnp.where(kmax > 0, (kmax & id_mask).astype(jnp.int32) - 1, -1)
+    ids1 = jnp.where(kmax > 0,
+                     (kmax & jnp.uint32(ID_MASK)).astype(jnp.int32), -1)
     ts1 = jnp.where(kmax > 0, (pacc >> 12) - 1, 0)
     hb1 = jnp.where(kmax > 0, (pacc & 0xFFF) - 1, 0)
     stale = (ids1 >= 0) & (t - ts1 >= t_remove) & ops_r
@@ -324,8 +325,8 @@ def fused_overlay_tick(idsaux, pw, intro, masks, scalars, *,
 
     i32 = jnp.int32
     sp = jnp.concatenate([scalars.astype(i32),
-                          jnp.reshape(row_start, (1,)).astype(i32),
                           masks_local.astype(i32), masks.astype(i32)])
+    rs = jnp.reshape(row_start, (1,)).astype(i32)
 
     row_block_w = pl.BlockSpec((b, w_cols), lambda i, sp_ref: (i, 0),
                                memory_space=pltpu.VMEM)
@@ -335,7 +336,7 @@ def fused_overlay_tick(idsaux, pw, intro, masks, scalars, *,
     def xor_spec(fi, cols):
         return pl.BlockSpec(
             (b, cols),
-            lambda i, sp_ref, fi=fi: (i ^ (sp_ref[9 + fi] // b), 0),
+            lambda i, sp_ref, fi=fi: (i ^ (sp_ref[8 + fi] // b), 0),
             memory_space=pltpu.VMEM)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -345,7 +346,8 @@ def fused_overlay_tick(idsaux, pw, intro, masks, scalars, *,
         + [xor_spec(fi, w_cols) for fi in range(f_rounds)]
         + [xor_spec(fi, k) for fi in range(f_rounds)]
         + [pl.BlockSpec((8, k), lambda i, sp_ref: (0, 0),
-                        memory_space=pltpu.VMEM)],
+                        memory_space=pltpu.VMEM),
+           pl.BlockSpec(memory_space=pltpu.SMEM)],
         out_specs=[
             row_block_k,
             row_block_k,
@@ -368,5 +370,5 @@ def fused_overlay_tick(idsaux, pw, intro, masks, scalars, *,
         ],
         interpret=interpret,
     )(sp, idsaux, pw, *[aux_rounds[fi] for fi in range(f_rounds)],
-      *[pw_rounds[fi] for fi in range(f_rounds)], intro)
+      *[pw_rounds[fi] for fi in range(f_rounds)], intro, rs)
     return ids2, hb2, tsc[:, :k], tsc[:, k:k + N_COUNTERS]

@@ -1,0 +1,574 @@
+"""Grid-scale multi-tick Pallas megakernel: S overlay ticks per launch
+with HBM-resident double-buffered state, for N above the VMEM envelope.
+
+The whole-state-in-VMEM megakernel (overlay_mega.py) caps at
+N <= MEGA_N_LIMIT; above it the per-tick fused kernel
+(overlay_exchange.py) paid a fixed ~300-450 us Pallas launch plus an
+~0.5-11.7 ms tail of per-tick XLA vector phases (docs/PERF.md) — at
+N=65k/1M that floor was most of the tick, exactly the fixed cost the
+reference's plain per-tick loop does not have
+(/root/reference/Application.cpp:99-163).  This kernel removes it at
+grid scale:
+
+* **One packed state plane.**  ids and the packed (ts, hb) payload
+  words share a single (N, 2K) i32 plane (2K <= 128 lanes = one native
+  tile).  The per-peer aux state (own_hb, in_group, joinreq, joinrep,
+  the F send flags — <= 24 bits total) rides the three spare HIGH
+  bytes of pw lanes 0-2: pw words use only 24 bits ((ts+1)<<12 |
+  hb+1), so the aux bytes are free HBM traffic.  Versus the two-plane
+  per-tick kernel this halves plane traffic (docs/PERF.md item 1).
+  Start/fail/rejoin/degree schedule columns are not stored at all:
+  they are closed-form counter hashes recomputed in-kernel (the
+  start-ramp comparisons are division-free:
+  t > i*num//den  <=>  i*num < t*den).
+* **Double-buffered HBM state.**  The state plane lives in ANY memory
+  as a (2, N, 2K) OUTPUT buffer; grid step (s, i) manually DMAs its
+  own row block plus the F XOR-partner blocks from phase s%2 and
+  writes phase 1-s%2.  TPU grid execution is sequential and
+  lexicographic, so every tick-s block is committed before any
+  tick-(s+1) read — the cross-tick XOR-partner reads are well-defined
+  (docs/PERF.md item 3).  Tick 0 reads a separate read-only init
+  input (interpret mode does not propagate aliased writes back to
+  reads, and the pure-output revolver is backend-agnostic; the init
+  input also carries the boot row for the q scratch, see below).
+* **Everything in-kernel** (docs/PERF.md item 2 — no per-tick XLA
+  phases remain).  Each (s, i) step runs the complete tick for its
+  rows: churn wipe (applied on load, to own and partner blocks alike),
+  join/start decisions, F XOR exchange rounds (high mask bits pick the
+  partner block, low bits are the in-VMEM group-roll butterfly), the
+  lane-aligned lexicographic merges, JOINREP (the introducer's row
+  snapshot revolves through scratch: the block that writes the
+  introducer's row at tick s publishes tick s+1's broadcast), JOINREQ
+  (tick s+1's per-slot aggregate is accumulated across tick-s blocks
+  in scratch — a cross-block reduction made free by sequential grid
+  order), winner extraction, TREMOVE staleness detection, the
+  SLOT_EPOCH re-slot pass, drop-masked dissemination, and the
+  per-tick metric rows.
+* **Same bits.**  All randomness is the same counter-hash streams
+  (utils/hash32.mix32); per-launch XOR masks ride scalar prefetch.
+  Bit-identical to the XLA tick (tests/test_overlay_grid.py).
+
+Scope: single device, power-of-two N with 2K <= 128, N a multiple of
+the (power-of-two) row-block size, INTRODUCER in block 0, runs capped
+at 4094 ticks, and step_num*(N-1) < 2^31 (the division-free ramp
+comparisons must not overflow i32).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .overlay_mega import (MET_ADDS, MET_FALSE_REMOVALS,  # noqa: F401
+                           MET_IN_GROUP, MET_RECV, MET_REMOVALS, MET_SENT,
+                           MET_VICTIM, MET_VIEW, _lex, _sum_all, _umax0)
+
+#: protocol ticks per launch (launch-floor amortization factor)
+GRID_TICKS = 16
+
+#: stored plane width: Mosaic requires DMA slices to be lane-aligned
+#: to the (1, 128) tiling, so the (N, 2K) data plane is padded to a
+#: full native tile width (zero extra HBM at K=64 — the tile padding
+#: exists either way)
+PLANE_W = 128
+
+#: default row-block height (static; harness may override)
+GRID_BLOCK_ROWS = 512
+
+#: scalar-prefetch layout (deg thresholds + per-tick masks follow)
+_GSP_T0 = 0
+_GSP_SEED = 1
+_GSP_VLO = 2
+_GSP_VHI = 3
+_GSP_FTICK = 4
+_GSP_RAFTER = 5
+_GSP_CTHR = 6
+_GSP_CAFTER = 7
+_GSP_DROP_ON = 8
+_GSP_DROP_OPEN = 9
+_GSP_DROP_CLOSE = 10
+_GSP_DROP_THR = 11
+_GSP_FAIL0 = 12
+_GSP_REJOIN0 = 13
+_GSP_STEP_NUM = 14
+_GSP_STEP_DEN = 15
+_GSP_NSCALARS = 16
+
+_SIGN_I32 = np.int32(-2147483648)
+
+#: aux bits ride the high bytes of pw lanes 0-2 (pw words are 24-bit):
+#: lane 0 byte: own_hb bits [0, 8); lane 1 byte: own_hb bits [8, 12) |
+#: in_group << 4 | joinreq << 5 | joinrep << 6; lane 2 byte: the
+#: per-round send-flag bits (F <= 8)
+_PW_MASK = 0x00FFFFFF
+
+
+def _umax_i32(a, b):
+    """Elementwise uint32 max on i32 bit patterns (sign-flip compare)."""
+    return jnp.where((a ^ _SIGN_I32) > (b ^ _SIGN_I32), a, b)
+
+
+def _xor_group_roll(x, sh: int):
+    """x[r ^ sh] for power-of-two ``sh``: a roll-by-sh within each
+    2sh-row group — one reshape+concat (overlay_mega.py phase A2)."""
+    b, w = x.shape
+    z = x.reshape(b // (2 * sh), 2 * sh, w)
+    return jnp.concatenate([z[:, sh:], z[:, :sh]], axis=1).reshape(b, w)
+
+
+def pack_aux_lanes(pw, own_hb, in_group, joinreq, joinrep, sf_bits):
+    """Attach the aux bytes to pw lanes 0-2 (all i32; (rows, 1) aux).
+
+    Shared by the kernel and the host harness so the plane layout has
+    exactly one definition."""
+    a0 = own_hb & 0xFF
+    a1 = ((own_hb >> 8) & 0xF) | (in_group << 4) | (joinreq << 5) \
+        | (joinrep << 6)
+    return jnp.concatenate(
+        [pw[:, 0:1] | (a0 << 24), pw[:, 1:2] | (a1 << 24),
+         pw[:, 2:3] | (sf_bits << 24), pw[:, 3:]], axis=1)
+
+
+def unpack_aux_lanes(pwr):
+    """(pw_clean, own_hb, a1, sf_bits) from raw pw lanes (inverse of
+    :func:`pack_aux_lanes`; a1 carries the three flag bits)."""
+    a0 = (pwr[:, 0:1] >> 24) & 0xFF
+    a1 = (pwr[:, 1:2] >> 24) & 0xFF
+    sf = (pwr[:, 2:3] >> 24) & 0xFF
+    return pwr & _PW_MASK, a0 | ((a1 & 0xF) << 8), a1, sf
+
+
+def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
+            t_remove: int, churn_lo: int,
+            churn_span: int, never: int, can_rejoin: bool, powerlaw: bool,
+            sp_ref, init_in, plane_out, met_out, *refs):
+    from ...config import INTRODUCER
+    from ...models.overlay import (ID_BITS, ID_MASK, SLOT_EPOCH,
+                                   _SALT_CHURN, _SALT_CHURN_TICK,
+                                   _SALT_DEGREE, _SALT_GOSSIP_DROP,
+                                   _SALT_JOINREP_DROP, _SALT_JOINREQ_DROP,
+                                   _pack_key, _pack_th, _slot_of)
+    from ...utils.hash32 import mix32
+
+    own_scr = refs[0]
+    part_scrs = refs[1:1 + f_rounds]
+    bc_cur, bc_nxt, q_cur, q_nxt, sems = refs[1 + f_rounds:]
+
+    i32 = jnp.int32
+    w = 2 * k                # data lanes; the plane is padded to PLANE_W
+    #                          (Mosaic DMA slices must be 128-aligned
+    #                          along lanes)
+    s = pl.program_id(0)
+    i = pl.program_id(1)
+    t = sp_ref[_GSP_T0] + s
+    tu = t.astype(jnp.uint32)
+    phase = jax.lax.rem(s, 2)
+    seed = sp_ref[_GSP_SEED].astype(jnp.uint32)
+    churn_thr = sp_ref[_GSP_CTHR].astype(jnp.uint32)
+    drop_thr = sp_ref[_GSP_DROP_THR].astype(jnp.uint32)
+    ns = _GSP_NSCALARS + max(f_rounds - 1, 0)      # masks offset
+    masks = [sp_ref[ns + s * f_rounds + fi] for fi in range(f_rounds)]
+
+    # ---- DMA in: own block + F XOR-partner blocks ------------------
+    # (tick 0 reads the init input; later ticks read the previous
+    # tick's committed phase.  The waits use size-matched descriptors:
+    # both sources transfer identical byte counts.)
+    def start_load(dst, row0, sem):
+        @pl.when(s == 0)
+        def _():
+            pltpu.make_async_copy(init_in.at[pl.ds(row0, b), :],
+                                  dst, sem).start()
+
+        @pl.when(s > 0)
+        def _():
+            pltpu.make_async_copy(plane_out.at[phase, pl.ds(row0, b), :],
+                                  dst, sem).start()
+
+    start_load(own_scr, i * b, sems.at[0])
+    for fi in range(f_rounds):
+        pblk = i ^ (masks[fi] // b)
+        start_load(part_scrs[fi], pblk * b, sems.at[1 + fi])
+    pltpu.make_async_copy(init_in.at[pl.ds(0, b), :], own_scr,
+                          sems.at[0]).wait()
+    for fi in range(f_rounds):
+        pltpu.make_async_copy(init_in.at[pl.ds(0, b), :], part_scrs[fi],
+                              sems.at[1 + fi]).wait()
+
+    # ---- tick-boundary revolves (first block of each tick) ---------
+    @pl.when((i == 0) & (s == 0))
+    def _():
+        # boot rows [N, N+8): row N the introducer broadcast row, row
+        # N+1 the JOINREQ aggregate (ANY-space input, so DMA through
+        # the bc scratch; the store semaphore is idle here)
+        cp = pltpu.make_async_copy(init_in.at[pl.ds(n, 8), :], bc_cur,
+                                   sems.at[1 + f_rounds])
+        cp.start()
+        cp.wait()
+        q_cur[0:1, :] = bc_cur[1:2, 0:k]
+
+    @pl.when((i == 0) & (s > 0))
+    def _():
+        bc_cur[0:1, :] = bc_nxt[0:1, :]
+        q_cur[0:1, :] = q_nxt[0:1, :]
+
+    @pl.when(i == 0)
+    def _():
+        q_nxt[0:1, :] = jnp.zeros((1, k), i32)
+        met_out[pl.ds(s, 1), :] = jnp.zeros((1, 128), i32)
+
+    # ---- introducer gates + schedule helpers -----------------------
+    fail0 = sp_ref[_GSP_FAIL0]
+    rejoin0 = sp_ref[_GSP_REJOIN0]
+    failed0 = (t > fail0) & (t <= rejoin0)
+    proc0 = (t > 0) & jnp.logical_not(failed0)
+    slot_ep = (t // SLOT_EPOCH).astype(jnp.uint32)
+
+    def sched_of(subj):
+        """(fail, rejoin) of subject ids — closed form, any shape."""
+        subj_u = subj.astype(jnp.uint32)
+        churned = (mix32(seed, subj_u, np.uint32(_SALT_CHURN))
+                   < churn_thr) & (subj != INTRODUCER)
+        churn_fail = churn_lo + (
+            mix32(seed, subj_u, np.uint32(_SALT_CHURN_TICK))
+            % np.uint32(churn_span)).astype(i32)
+        scripted = jnp.where(
+            (subj >= sp_ref[_GSP_VLO]) & (subj < sp_ref[_GSP_VHI]),
+            sp_ref[_GSP_FTICK], never)
+        fail = jnp.where(churn_thr > 0,
+                         jnp.where(churned, churn_fail, never), scripted)
+        after = jnp.where(churn_thr > 0, sp_ref[_GSP_CAFTER],
+                          sp_ref[_GSP_RAFTER])
+        rejoin = jnp.where((fail != never) & (after != never),
+                           fail + after, never)
+        return fail, rejoin
+
+    # ---- own rows: unpack + wipe + decisions -----------------------
+    rows = i * b + jax.lax.broadcasted_iota(i32, (b, 1), 0)
+    rows_u = rows.astype(jnp.uint32)
+    kk = jax.lax.broadcasted_iota(i32, (b, k), 1)
+    fis = jax.lax.broadcasted_iota(i32, (b, f_rounds), 1)
+    is_intro = rows == INTRODUCER
+
+    raw = own_scr[:]
+    ids0 = raw[:, 0:k]
+    pw0, own_hb0, a1, _ = unpack_aux_lanes(raw[:, k:w])
+    in_group0 = (a1 & 0x10) > 0
+    joinreq0 = (a1 & 0x20) > 0
+    joinrep0 = (a1 & 0x40) > 0
+
+    fail, rejoin = sched_of(rows)
+    failed = (t > fail) & (t <= rejoin)
+    # division-free start ramp (see module docstring); num/den ride
+    # the sp vector so the runtime sched argument is honored like
+    # every other schedule field
+    step_num = sp_ref[_GSP_STEP_NUM]
+    step_den = sp_ref[_GSP_STEP_DEN]
+    ramp = rows * step_num
+    t_gt_start = ramp < t * step_den
+    at_start = (ramp >= t * step_den) & (ramp < (t + 1) * step_den)
+    proc = t_gt_start & ~failed
+    if can_rejoin:                            # churn wipe (own rows)
+        rejoining = t == rejoin
+        ids0 = jnp.where(rejoining, -1, ids0)
+        pw0 = jnp.where(rejoining, 0, pw0)
+        in_group0 = in_group0 & ~rejoining
+        own_hb0 = jnp.where(rejoining, 0, own_hb0)
+    else:
+        rejoining = jnp.zeros_like(is_intro)
+
+    jrep = joinrep0 & proc
+    in_group = in_group0 | jrep
+    starting = at_start | rejoining
+    in_group = in_group | (starting & is_intro)
+    ops = proc & in_group
+    own_hb = own_hb0 + ops.astype(i32)
+
+    # ---- merge accumulator init ------------------------------------
+    # the key's ts+1 field IS the pw word's high field: no unpack
+    kmax = jnp.where(ids0 >= 0,
+                     ((pw0 >> 12).astype(jnp.uint32) << ID_BITS)
+                     | ids0.astype(jnp.uint32),
+                     jnp.uint32(0))
+    pacc = pw0
+    recv = jnp.zeros((b, 1), i32)
+    # freshness gate on the packed word: t - ts < t_remove  <=>
+    # ts + 1 >= t - t_remove + 2  <=>  pw >= (t - t_remove + 2) << 12
+    # (the hb+1 bits below bit 12 are in [1, 4095], so they cannot
+    # carry a ts+1 = t-t_remove+1 word across the floor)
+    fresh_floor = (t - t_remove + 2) << 12
+    # direct entries: scalar-precomputed key/payload high fields
+    key_t1 = t.astype(jnp.uint32) << ID_BITS          # ts = t - 1
+    pw_t1 = t << 12                                   # _pack_th(t-1, .)
+
+    # ---- F exchange rounds -----------------------------------------
+    lgb = b.bit_length() - 1
+    for fi in range(f_rounds):
+        m = masks[fi]
+        for j in range(lgb):                 # in-block butterfly
+            sh = 1 << j
+
+            @pl.when(((m >> j) & 1) == 1)
+            def _(fi=fi, sh=sh):
+                part_scrs[fi][:] = _xor_group_roll(part_scrs[fi][:], sh)
+
+        wv = part_scrs[fi][:]
+        in_ids = wv[:, 0:k]
+        in_p, own_p, _, pa2 = unpack_aux_lanes(wv[:, k:w])
+        partner = rows ^ m
+        if can_rejoin:                       # wipe-on-load (partner)
+            _, prejoin = sched_of(partner)
+            prj = t == prejoin
+            in_ids = jnp.where(prj, -1, in_ids)
+            in_p = jnp.where(prj, 0, in_p)
+            own_p = jnp.where(prj, 0, own_p)
+        flag = ((pa2 >> fi) & 1) > 0
+        ok = flag & proc
+        valid = ok & (in_ids >= 0) & (in_p >= fresh_floor) \
+            & (in_ids != rows)
+        key = jnp.where(valid,
+                        ((in_p >> 12).astype(jnp.uint32) << ID_BITS)
+                        | in_ids.astype(jnp.uint32),
+                        jnp.uint32(0))
+        kmax, pacc = _lex(kmax, pacc, key, jnp.where(valid, in_p, 0))
+        if t_remove > 1:                     # partner self-entry (age 1)
+            psl = _slot_of(seed, slot_ep, partner, k)
+            pkey = jnp.where(ok, key_t1 | partner.astype(jnp.uint32),
+                             jnp.uint32(0))
+            pp = jnp.where(ok, pw_t1 | (own_p + 1), 0)
+            match = psl == kk
+            kmax, pacc = _lex(kmax, pacc,
+                              jnp.where(match, pkey, jnp.uint32(0)),
+                              jnp.where(match, pp, 0))
+        recv = recv + ok.astype(i32)
+
+    # ---- JOINREP: the introducer's broadcast view ------------------
+    bcrow = bc_cur[0:1, :]
+    bc_ids = bcrow[:, 0:k]
+    bc_pw, bc_hb, _, _ = unpack_aux_lanes(bcrow[:, k:w])
+    if can_rejoin:                           # wipe-on-load (introducer)
+        rejoining0 = t == rejoin0
+        bc_ids = jnp.where(rejoining0, -1, bc_ids)
+        bc_pw = jnp.where(rejoining0, 0, bc_pw)
+        bc_hb = jnp.where(rejoining0, 0, bc_hb)
+    j_valid = jrep & (bc_ids >= 0) & (bc_pw >= fresh_floor) \
+        & (bc_ids != rows)
+    jkey = jnp.where(j_valid,
+                     ((bc_pw >> 12).astype(jnp.uint32) << ID_BITS)
+                     | bc_ids.astype(jnp.uint32),
+                     jnp.uint32(0))
+    kmax, pacc = _lex(kmax, pacc, jkey, jnp.where(j_valid, bc_pw, 0))
+    if t_remove > 1:                         # the introducer's self-entry
+        intro_vec = jnp.zeros_like(rows) + INTRODUCER
+        islot = _slot_of(seed, slot_ep, intro_vec, k)
+        iok = jrep & ~is_intro
+        ikey = jnp.where(iok, key_t1 | jnp.uint32(INTRODUCER),
+                         jnp.uint32(0))
+        ip = jnp.where(iok, pw_t1 | (bc_hb + 1), 0)
+        imatch = islot == kk
+        kmax, pacc = _lex(kmax, pacc,
+                          jnp.where(imatch, ikey, jnp.uint32(0)),
+                          jnp.where(imatch, ip, 0))
+
+    # ---- JOINREQ aggregates into the introducer's row --------------
+    q_kf = q_cur[0:1, :].astype(jnp.uint32)
+    q_pf = jnp.where(q_kf > 0, _pack_th(t, 1), 0)
+    kmax, pacc = _lex(kmax, pacc,
+                      jnp.where(is_intro, q_kf, jnp.uint32(0)),
+                      jnp.where(is_intro, q_pf, 0))
+    jreq = joinreq0 & proc0
+
+    # ---- winner extraction + staleness detection -------------------
+    ids1 = jnp.where(kmax > 0,
+                     (kmax & jnp.uint32(ID_MASK)).astype(i32), -1)
+    ts1 = jnp.where(kmax > 0, (pacc >> 12) - 1, 0)
+    hb1 = jnp.where(kmax > 0, (pacc & 0xFFF) - 1, 0)
+    stale = (ids1 >= 0) & (t - ts1 >= t_remove) & ops
+    ids2 = jnp.where(stale, -1, ids1)
+    pw2 = jnp.where(stale | (ids1 < 0), 0, _pack_th(ts1, hb1))
+
+    # subject fail/rejoin for the accuracy metrics
+    subj = jnp.where(ids1 >= 0, ids1, 0)
+    s_fail, s_rejoin = sched_of(subj)
+    subj_failed = (t > s_fail) & (t <= s_rejoin)
+
+    # ---- dissemination: next tick's flags --------------------------
+    active = (sp_ref[_GSP_DROP_ON] > 0) & (t > sp_ref[_GSP_DROP_OPEN]) \
+        & (t <= sp_ref[_GSP_DROP_CLOSE])
+    gdrop = mix32(seed, tu, rows_u, fis.astype(jnp.uint32),
+                  np.uint32(_SALT_GOSSIP_DROP)) < drop_thr
+    sf_next = ops & ~(active & gdrop)
+    if powerlaw:
+        du = mix32(seed, rows_u, np.uint32(_SALT_DEGREE))
+        thr_hits = jnp.zeros((b, 1), i32)
+        for j in range(f_rounds - 1):
+            thr_hits = thr_hits + (
+                du < sp_ref[_GSP_NSCALARS + j].astype(jnp.uint32)
+            ).astype(i32)
+        deg = 1 + thr_hits
+        sf_next = sf_next & (fis < deg)
+    joinreq_new = starting & ~is_intro
+    qdrop = mix32(seed, tu, rows_u, np.uint32(_SALT_JOINREQ_DROP)) \
+        < drop_thr
+    pdrop = mix32(seed, tu, rows_u, np.uint32(_SALT_JOINREP_DROP)) \
+        < drop_thr
+    joinreq_sent = joinreq_new & ~(active & qdrop)
+    joinrep_sent = jreq & ~(active & pdrop)
+    live_hold = ~proc & ~failed
+    joinreq_next = joinreq_sent \
+        | (joinreq0 & jnp.logical_not(proc0) & jnp.logical_not(failed0))
+    joinrep_next = joinrep_sent | (joinrep0 & live_hold)
+
+    # ---- metrics (pre-re-slot table, like the XLA path) ------------
+    delta = jnp.concatenate([
+        _sum_all(in_group),
+        _sum_all(ids2 >= 0),
+        _sum_all((ids1 != ids0) & (ids1 >= 0)),
+        _sum_all(stale),
+        _sum_all(stale & ~subj_failed),
+        _sum_all((ids2 >= 0) & subj_failed & ~stale),
+        _sum_all(sf_next) + _sum_all(joinreq_sent)
+        + _sum_all(joinrep_sent),
+        _sum_all(recv) + _sum_all(jrep) + _sum_all(jreq),
+    ], axis=1)
+    met_out[pl.ds(s, 1), 0:8] = met_out[pl.ds(s, 1), 0:8] + delta
+
+    # ---- tick s+1's JOINREQ aggregate (cross-block scratch) --------
+    t1 = t + 1
+    failed0_1 = (t1 > fail0) & (t1 <= rejoin0)
+    proc0_1 = (t1 > 0) & jnp.logical_not(failed0_1)
+    slot_ep1 = (t1 // SLOT_EPOCH).astype(jnp.uint32)
+    jq1 = joinreq_next & proc0_1 & ~is_intro
+    qslot1 = _slot_of(seed, slot_ep1, rows, k)
+    qkey1 = jnp.where(jq1, _pack_key(rows, jnp.zeros_like(rows) + t1),
+                      jnp.uint32(0))
+    cand = jnp.where(qslot1 == kk, qkey1, jnp.uint32(0))
+    blkmax = _umax0(cand).astype(i32)              # (1, K) key bits
+    q_nxt[0:1, :] = _umax_i32(q_nxt[0:1, :], blkmax)
+
+    # ---- pack + stage the new block in scratch ---------------------
+    pw_out = pack_aux_lanes(pw2, own_hb, in_group.astype(i32),
+                            joinreq_next.astype(i32),
+                            joinrep_next.astype(i32),
+                            (sf_next.astype(i32)
+                             << fis).sum(1, keepdims=True))
+    pad = [jnp.zeros((b, PLANE_W - w), i32)] if w < PLANE_W else []
+    own_scr[:] = jnp.concatenate([ids2, pw_out] + pad, axis=1)
+
+    # ---- SLOT_EPOCH re-roll (own rows; ref-staged, predicated) -----
+    @pl.when((t + 1) % SLOT_EPOCH == 0)
+    def _reslot():
+        cur = own_scr[:]
+        idsv = cur[:, 0:k]
+        pwv, r_hb, r_a1, r_sf = unpack_aux_lanes(cur[:, k:w])
+        tsv = (pwv >> 12) - 1
+        next_ep = slot_ep1
+        tgt = _slot_of(seed, next_ep, idsv, k)
+        key = jnp.where(idsv >= 0, _pack_key(idsv, tsv),
+                        jnp.uint32(0))
+
+        # pairwise lex-max reduction tree over the K source slots
+        # (associative + commutative; see overlay_mega.py phase C)
+        def cand_slot(j):
+            match = tgt[:, j:j + 1] == kk
+            return (jnp.where(match, key[:, j:j + 1], jnp.uint32(0)),
+                    jnp.where(match, pwv[:, j:j + 1], 0))
+
+        def reduce_slots(lo, hi):
+            if hi - lo == 1:
+                return cand_slot(lo)
+            mid = (lo + hi) // 2
+            ka, pa = reduce_slots(lo, mid)
+            kb, pb = reduce_slots(mid, hi)
+            return _lex(ka, pa, kb, pb)
+
+        kf, pf = reduce_slots(0, k)
+        ids_r = jnp.where(kf > 0,
+                          (kf & jnp.uint32(ID_MASK)).astype(i32), -1)
+        pw_r = jnp.where(kf > 0, pf, 0)
+        own_scr[:] = jnp.concatenate(
+            [ids_r, pack_aux_lanes(pw_r, r_hb, (r_a1 >> 4) & 1,
+                                   (r_a1 >> 5) & 1, (r_a1 >> 6) & 1,
+                                   r_sf)] + pad, axis=1)
+
+    # ---- publish tick s+1's introducer broadcast row ---------------
+    @pl.when(i == INTRODUCER // b)
+    def _():
+        bc_nxt[0:1, :] = own_scr[INTRODUCER % b:INTRODUCER % b + 1, :]
+
+    # ---- DMA out: commit the block to the next phase ---------------
+    st = pltpu.make_async_copy(
+        own_scr, plane_out.at[1 - phase, pl.ds(i * b, b), :],
+        sems.at[1 + f_rounds])
+    st.start()
+    st.wait()
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n", "k", "f_rounds", "s_ticks", "b",
+                              "t_remove",
+                              "churn_lo", "churn_span", "can_rejoin",
+                              "powerlaw", "interpret"))
+def grid_overlay_ticks(init, sp, *, n: int, k: int, f_rounds: int,
+                       s_ticks: int, b: int, t_remove: int,
+                       churn_lo: int,
+                       churn_span: int, can_rejoin: bool, powerlaw: bool,
+                       interpret: bool | None = None):
+    """Run ``s_ticks`` whole overlay ticks in one grid-scale launch.
+
+    Args:
+      init: i32[N + 8, PLANE_W] — rows [0, N) the packed state plane
+        (lanes [0, K) ids, [K, 2K) pw-with-aux-bytes, rest zero pad —
+        see module docstring); row N the boot introducer broadcast row
+        (the introducer's plane row at the launch's start tick,
+        pre-wipe); row N+1 lanes [0, K) the boot JOINREQ aggregate
+        (uint32 key bits as i32) for the start tick.
+      sp: i32[NS + (F-1) + s_ticks*F] scalars, power-law degree
+        thresholds, and the per-tick XOR masks.
+
+    Returns ``(plane2 i32[2, N, 2K], metrics i32[s_ticks, 128])`` —
+    the end state is ``plane2[s_ticks % 2]``; metric columns per the
+    MET_* constants of overlay_mega.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    assert init.shape == (n + 8, PLANE_W) and 2 * k <= PLANE_W, \
+        (init.shape, k)
+    assert n % b == 0 and b & (b - 1) == 0 and 8 <= b, (n, b)
+    assert f_rounds <= 8
+    from ...config import INTRODUCER
+    from ...state import NEVER
+    assert INTRODUCER < b, "introducer must live in row block 0"
+    nb = n // b
+    i32 = jnp.int32
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(s_ticks, nb),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((s_ticks, 128), lambda s, i, sp: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[pltpu.VMEM((b, PLANE_W), i32)
+                        for _ in range(1 + f_rounds)]
+        + [pltpu.VMEM((8, PLANE_W), i32), pltpu.VMEM((8, PLANE_W), i32),
+           pltpu.VMEM((8, k), i32), pltpu.VMEM((8, k), i32),
+           pltpu.SemaphoreType.DMA((f_rounds + 2,))],
+    )
+    plane2, met = pl.pallas_call(
+        functools.partial(_kernel, n, k, f_rounds, s_ticks, b, t_remove,
+                          churn_lo, churn_span,
+                          int(NEVER), can_rejoin, powerlaw),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((2, n, PLANE_W), i32),
+                   jax.ShapeDtypeStruct((s_ticks, 128), i32)],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(sp, init)
+    return plane2, met

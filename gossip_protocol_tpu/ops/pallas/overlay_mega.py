@@ -139,8 +139,7 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, t_remove: int,
     from ...models.overlay import (ID_MASK, SLOT_EPOCH, _SALT_CHURN,
                                    _SALT_CHURN_TICK, _SALT_GOSSIP_DROP,
                                    _SALT_JOINREP_DROP, _SALT_JOINREQ_DROP,
-                                   _pack_key, _pack_key_direct, _pack_th,
-                                   _slot_of)
+                                   _pack_key, _pack_th, _slot_of)
     from ...utils.hash32 import mix32
 
     a = 2 * k                                   # aux lane base
@@ -192,8 +191,7 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, t_remove: int,
         q_slot = _slot_of(seed, slot_ep, rows_n, k)
         q_ok = jreq_n & (rows_n != INTRODUCER)
         q_key = jnp.where(q_ok,
-                          _pack_key_direct(t, rows_n,
-                                           jnp.zeros_like(rows_n) + t),
+                          _pack_key(rows_n, jnp.zeros_like(rows_n) + t),
                           jnp.uint32(0))
         q_kf = _umax0(jnp.where(q_slot == kk_n, q_key, jnp.uint32(0)))
         q_pf = jnp.where(q_kf > 0, _pack_th(t, 1), 0)        # (1, K)
@@ -256,8 +254,7 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, t_remove: int,
 
             # accumulator init
             ts0 = (pw0 >> 12) - 1
-            kmax = jnp.where(ids0 >= 0,
-                             _pack_key(seed, t, rows_u, ids0, ts0),
+            kmax = jnp.where(ids0 >= 0, _pack_key(ids0, ts0),
                              jnp.uint32(0))
             pacc = pw0
             recv = jnp.zeros((b, 1), i32)
@@ -274,8 +271,7 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, t_remove: int,
                 ok = flag & proc
                 valid = ok & (in_ids >= 0) & (t - in_ts < t_remove) \
                     & (in_ids != rows)
-                key = jnp.where(valid,
-                                _pack_key(seed, t, rows_u, in_ids, in_ts),
+                key = jnp.where(valid, _pack_key(in_ids, in_ts),
                                 jnp.uint32(0))
                 kmax, pacc = _lex(kmax, pacc, key,
                                   jnp.where(valid, in_p, 0))
@@ -283,8 +279,7 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, t_remove: int,
                     partner = rows ^ m
                     psl = _slot_of(seed, slot_ep, partner, k)
                     e_ts = jnp.zeros_like(partner) + (t - 1)
-                    pkey = jnp.where(ok,
-                                     _pack_key_direct(t, partner, e_ts),
+                    pkey = jnp.where(ok, _pack_key(partner, e_ts),
                                      jnp.uint32(0))
                     pp = jnp.where(ok, _pack_th(e_ts, own_p), 0)
                     match = psl == kk_b
@@ -299,8 +294,7 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, t_remove: int,
             bc_ts = (bc_p >> 12) - 1
             j_valid = jrep & (bc_ids >= 0) & (t - bc_ts < t_remove) \
                 & (bc_ids != rows)
-            jkey = jnp.where(j_valid,
-                             _pack_key(seed, t, rows_u, bc_ids, bc_ts),
+            jkey = jnp.where(j_valid, _pack_key(bc_ids, bc_ts),
                              jnp.uint32(0))
             kmax, pacc = _lex(kmax, pacc, jkey,
                               jnp.where(j_valid, bc_p, 0))
@@ -309,7 +303,7 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, t_remove: int,
                 islot = _slot_of(seed, slot_ep, intro_vec, k)
                 e_ts = jnp.zeros_like(rows) + (t - 1)
                 iok = jrep & ~is_intro
-                ikey = jnp.where(iok, _pack_key_direct(t, intro_vec, e_ts),
+                ikey = jnp.where(iok, _pack_key(intro_vec, e_ts),
                                  jnp.uint32(0))
                 ip = jnp.where(iok,
                                _pack_th(e_ts,
@@ -327,8 +321,7 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, t_remove: int,
 
             # winner extraction + staleness detection
             ids1 = jnp.where(kmax > 0,
-                             (kmax & jnp.uint32(ID_MASK)).astype(i32) - 1,
-                             -1)
+                             (kmax & jnp.uint32(ID_MASK)).astype(i32), -1)
             ts1 = jnp.where(kmax > 0, (pacc >> 12) - 1, 0)
             hb1 = jnp.where(kmax > 0, (pacc & 0xFFF) - 1, 0)
             stale = (ids1 >= 0) & (t - ts1 >= t_remove) & ops
@@ -420,9 +413,7 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, t_remove: int,
             tsv = (pwv >> 12) - 1
             next_ep = ((t + 1) // SLOT_EPOCH).astype(jnp.uint32)
             tgt = _slot_of(seed, next_ep, idsv, k)
-            key = jnp.where(idsv >= 0,
-                            _pack_key(seed, t, rows_n.astype(jnp.uint32),
-                                      idsv, tsv),
+            key = jnp.where(idsv >= 0, _pack_key(idsv, tsv),
                             jnp.uint32(0))
 
             # contention resolved by a pairwise lex-max reduction TREE
@@ -447,8 +438,7 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, t_remove: int,
 
             kf, pf = reduce_slots(0, k)
             ids_r = jnp.where(kf > 0,
-                              (kf & jnp.uint32(ID_MASK)).astype(i32) - 1,
-                              -1)
+                              (kf & jnp.uint32(ID_MASK)).astype(i32), -1)
             pw_r = jnp.where(kf > 0, pf, 0)
             st_out[:] = jnp.concatenate([ids_r, pw_r, cur[:, a:]], axis=1)
 

@@ -16,12 +16,11 @@ from __future__ import annotations
 import numpy as np
 
 from ..config import INTRODUCER, SimConfig
-from ..models.overlay import (BAND, EPOCH, ID_BITS, SLOT_EPOCH, _SALT_CHURN,
+from ..models.overlay import (ID_BITS, SLOT_EPOCH, _SALT_CHURN,
                               _SALT_CHURN_TICK, _SALT_DEGREE,
                               _SALT_GOSSIP_DROP, _SALT_JOINREP_DROP,
                               _SALT_JOINREQ_DROP, _SALT_MASK, _SALT_SLOT,
-                              _TIE_BITS, _pack_th, degree_thresholds,
-                              resolved_dims)
+                              _pack_th, degree_thresholds, resolved_dims)
 from ..state import NEVER
 from ..utils.hash32 import mix32, threshold32
 
@@ -102,19 +101,15 @@ class OverlayOracle:
                          U(_SALT_SLOT)) % self.k)
 
     def key(self, t, r, j, ts):
-        age = min(max(t - ts, 0), 8 * BAND - 1)
-        band = (7 - age // BAND) << (ID_BITS + _TIE_BITS)
-        tie = (int(mix32(self.seed, U(t // EPOCH), U(r), U(np.uint32(j))))
-               >> (32 - _TIE_BITS)) << ID_BITS
-        return band | tie | (j + 1)
+        """Freshness-majorized slot key (models/overlay.py _pack_key):
+        (ts+1) << ID_BITS | id — receiver-independent; ``t``/``r``
+        kept in the signature for call-site symmetry."""
+        return ((ts + 1) << ID_BITS) | j
 
     def key_direct(self, t, j, ts):
-        """Saturated-tie key of a direct self-entry / JOINREQ
-        (models/overlay.py _pack_key_direct)."""
-        age = min(max(t - ts, 0), 8 * BAND - 1)
-        band = (7 - age // BAND) << (ID_BITS + _TIE_BITS)
-        tie = ((1 << _TIE_BITS) - 1) << ID_BITS
-        return band | tie | (j + 1)
+        """A direct self-entry / JOINREQ carries the same key; its
+        merge-time-maximal ts is the structural boost."""
+        return self.key(t, 0, j, ts)
 
     def mask(self, t, fi):
         return int(mix32(self.seed, U(np.uint32(t & 0xFFFFFFFF)), U(fi),
@@ -210,7 +205,7 @@ class OverlayOracle:
                     if ckey == kkey:
                         p = max(p, pack_th(int(self.ts[r, sl]),
                                            int(self.hb[r, sl])))
-                new_ids[r, sl] = (kkey & ((1 << ID_BITS) - 1)) - 1
+                new_ids[r, sl] = kkey & ((1 << ID_BITS) - 1)
                 new_ts[r, sl] = (p >> 12) - 1
                 new_hb[r, sl] = (p & 0xFFF) - 1
 
@@ -265,7 +260,7 @@ class OverlayOracle:
                     elif kkey == cur[0]:
                         cur[1] = max(cur[1], p)
                 for sl, (kkey, p) in best.items():
-                    rm_ids[r, sl] = (kkey & ((1 << ID_BITS) - 1)) - 1
+                    rm_ids[r, sl] = kkey & ((1 << ID_BITS) - 1)
                     rm_ts[r, sl] = (p >> 12) - 1
                     rm_hb[r, sl] = (p & 0xFFF) - 1
             new_ids, new_hb, new_ts = rm_ids, rm_hb, rm_ts

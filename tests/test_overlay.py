@@ -67,10 +67,31 @@ def test_overlay_oracle_parity(name, kw):
         assert int(m.removals) == counters["removals"], (name, t)
 
 
+def _assert_coverage_holes_transient(unc, n, bound=None, budget=0.001):
+    """The coverage contract on a live_uncovered series: holes are
+    transient (re-covered within SLOT_EPOCH + 1 ticks — the bound
+    test_recover_bound establishes; under the freshness-majorized key
+    a member re-covers via its next direct reseed, typically 1 tick)
+    and rare (a tiny fraction of member-ticks)."""
+    from gossip_protocol_tpu.models.overlay import SLOT_EPOCH
+    unc = np.asarray(unc)
+    # a -1 means live_uncovered was not tracked (kernel-path sentinel):
+    # this helper would then pass vacuously, so fail loudly instead
+    assert (unc >= 0).all(), "live_uncovered not tracked on this path"
+    bound = SLOT_EPOCH + 1 if bound is None else bound
+    run = 0
+    for t, v in enumerate(unc):
+        run = run + 1 if v > 0 else 0
+        assert run <= bound, f"coverage hole persisted {run} ticks at {t}"
+    assert unc.sum() <= max(3, budget * n * unc.size), \
+        f"coverage holes too frequent ({unc.sum()} member-ticks)"
+
+
 def test_overlay_converges_and_detects():
     """N=512: everyone joins, the union of views covers every live
-    member every tick after the join phase, and the victim is purged
-    from all views within the detection horizon."""
+    member (holes only transient, within the re-cover bound) after the
+    join phase, and the victim is purged from all views within the
+    detection horizon."""
     cfg = SimConfig(max_nnb=512, model="overlay", single_failure=True,
                     drop_msg=False, seed=1, total_ticks=220, fail_tick=120)
     res = OverlaySimulation(cfg).run()
@@ -80,8 +101,10 @@ def test_overlay_converges_and_detects():
     last_start = int(cfg.step_rate * (n - 1))
     assert joined.size and joined[0] <= last_start + 4, "join phase too slow"
     # global coverage of live members holds once the last joiner's
-    # first gossip lands
-    assert (np.asarray(m.live_uncovered)[joined[0] + 3:] == 0).all()
+    # first gossip lands — transient single-tick holes within the
+    # re-cover bound are the documented contention background
+    _assert_coverage_holes_transient(
+        np.asarray(m.live_uncovered)[joined[0] + 3:], n)
     # victim purged from every view within TREMOVE + sampling slack
     vs = np.asarray(m.victim_slots)
     horizon = cfg.fail_tick + cfg.t_remove + 10
@@ -163,8 +186,10 @@ def test_overlay_powerlaw_topology():
     m = res.metrics
     joined = np.flatnonzero(np.asarray(m.in_group) == cfg.n)
     assert joined.size
-    # coverage: direct self-entries guarantee it even for degree-1 leaves
-    assert (np.asarray(m.live_uncovered)[joined[0] + 3:] == 0).all()
+    # coverage: direct self-entries re-seed it even for degree-1 leaves
+    # (holes only transient, within the re-cover bound)
+    _assert_coverage_holes_transient(
+        np.asarray(m.live_uncovered)[joined[0] + 3:], cfg.n)
     # victim purged (low supply -> allow extra sampling slack)
     vs = np.asarray(m.victim_slots)
     assert (vs[cfg.fail_tick + cfg.t_remove + 20:] == 0).all()
@@ -260,12 +285,14 @@ def test_recover_bound():
     member uncovered in a snapshot is re-covered within
     ``SLOT_EPOCH + 1`` ticks.
 
-    Why the bound holds: a live member's boosted self-entry
-    (saturated tie field, models/overlay.py _pack_key_direct) is
-    reseeded at F fresh partners every tick and outranks every
-    same-band hashed-tie rival — it can only keep losing to *other
-    direct entries* colliding in the same global slot, and the
-    SLOT_EPOCH re-roll retires any such collision pair, so the gap
+    Why the bound holds: a live member's self-entry is reseeded at F
+    fresh (per-tick re-randomized) partners every tick, and under the
+    freshness-majorized key (models/overlay.py _pack_key) its tick-
+    (t-1) timestamp outranks every relayed table rival — it can only
+    keep losing to *equal-ts rivals* (other direct entries, or a
+    relayed copy of a JOINREQ entry) colliding in the same global
+    slot with a larger id, and both the per-tick partner re-draw and
+    the SLOT_EPOCH re-roll retire any such collision, so the gap
     cannot outlive the current epoch plus the one tick the next send
     needs to land.  Provoked here with a deliberately tiny view
     (K=8 at N=512: 64x slot contention vs auto-K) so snapshot holes

@@ -1,0 +1,148 @@
+"""Differential tests: grid-scale multi-tick kernel vs the XLA path.
+
+The grid kernel (ops/pallas/overlay_grid.py + models/overlay_grid.py)
+must replay the exact trajectory of the per-tick XLA formulation —
+final state bit-identical, per-tick metrics identical except
+``live_uncovered`` (the grid path reports the -1 "not tracked"
+sentinel).  Tests force a small row-block so multiple grid blocks and
+the cross-block XOR partner DMA are exercised; on CPU the kernel runs
+in interpret mode, and the same contract holds compiled on TPU
+(exercised by bench.py).
+"""
+
+import numpy as np
+import pytest
+
+from gossip_protocol_tpu.config import SimConfig
+from gossip_protocol_tpu.models.overlay import (init_overlay_state,
+                                                make_overlay_run,
+                                                make_overlay_schedule)
+from gossip_protocol_tpu.models.overlay_grid import (grid_supported,
+                                                     make_grid_run,
+                                                     pack_grid_plane,
+                                                     unpack_grid_plane)
+
+STATE_FIELDS = ("tick", "ids", "hb", "ts", "in_group", "own_hb",
+                "send_flags", "joinreq", "joinrep")
+METRIC_FIELDS = ("in_group", "view_slots", "adds", "removals",
+                 "false_removals", "victim_slots", "sent", "recv")
+
+#: small row block so n=64 runs as multiple grid blocks (the real
+#: default is 512; the kernel is shape-generic in the block height)
+BLOCK = 32
+
+
+def _cfg(scenario, n):
+    if scenario == "ramp_fail":
+        return SimConfig(max_nnb=n, model="overlay", single_failure=True,
+                         drop_msg=False, seed=3, total_ticks=120,
+                         fail_tick=40, step_rate=0.5)
+    if scenario == "drop":
+        return SimConfig(max_nnb=n, model="overlay", single_failure=True,
+                         drop_msg=True, msg_drop_prob=0.3, seed=5,
+                         total_ticks=120, fail_tick=60, step_rate=0.25,
+                         drop_open_tick=10, drop_close_tick=100)
+    if scenario == "churn":
+        return SimConfig(max_nnb=n, model="overlay", single_failure=False,
+                         drop_msg=False, seed=7, total_ticks=200,
+                         churn_rate=0.25, rejoin_after=30,
+                         step_rate=40.0 / n)
+    if scenario == "aged":
+        # tiny TREMOVE + a long drop window: entries routinely age to
+        # exactly t_remove in a partner's table, exercising the packed
+        # freshness floor's boundary (t - ts < t_remove must exclude
+        # age == t_remove — the XLA path is the arbiter)
+        return SimConfig(max_nnb=n, model="overlay", single_failure=True,
+                         drop_msg=True, msg_drop_prob=0.6, seed=13,
+                         total_ticks=120, fail_tick=60, t_remove=3,
+                         step_rate=1.0, drop_open_tick=2,
+                         drop_close_tick=118)
+    if scenario == "powerlaw":
+        # fanout capped at 5: interpret-mode execution degrades
+        # pathologically at exactly 8 unrolled exchange rounds (see
+        # overlay_mega.mega_supported); the capped power-law still
+        # exercises the in-kernel out-degree gating
+        return SimConfig(max_nnb=n, model="overlay", single_failure=True,
+                         drop_msg=False, seed=9, total_ticks=120,
+                         fail_tick=50, step_rate=0.5, topology="powerlaw",
+                         fanout=5)
+    raise ValueError(scenario)
+
+
+def _compare(cfg, length):
+    sched = make_overlay_schedule(cfg)
+    state = init_overlay_state(cfg)
+    run_x = make_overlay_run(cfg, length, use_pallas=False)
+    run_g = make_grid_run(cfg, length, block_rows=BLOCK)
+    fx, mx = run_x(state, sched)
+    fg, mg = run_g(state, sched)
+    for name in STATE_FIELDS:
+        a, b = np.asarray(getattr(fx, name)), np.asarray(getattr(fg, name))
+        assert np.array_equal(a, b), f"state field {name} diverged"
+    for name in METRIC_FIELDS:
+        a, b = np.asarray(getattr(mx, name)), np.asarray(getattr(mg, name))
+        assert np.array_equal(a, b), \
+            f"metric {name} diverged at ticks {np.flatnonzero(a != b)[:5]}"
+    assert np.all(np.asarray(mg.live_uncovered) == -1)
+    return fg
+
+
+@pytest.mark.parametrize("scenario,n", [
+    ("ramp_fail", 64),
+    ("drop", 128),
+    ("churn", 64),
+    ("powerlaw", 64),
+    ("aged", 64),
+])
+def test_grid_kernel_bitwise_equals_xla(scenario, n):
+    cfg = _cfg(scenario, n)
+    # 44 = 2 full GRID_TICKS chunks + a 12-tick remainder launch,
+    # crossing two SLOT_EPOCH re-slot boundaries
+    _compare(cfg, 44)
+
+
+def test_grid_kernel_full_run_with_churn_cycle():
+    """A whole churn run: ramp, churn fails, rejoins, steady state."""
+    cfg = _cfg("churn", 64)
+    final = _compare(cfg, cfg.total_ticks)
+    assert int(np.asarray(final.in_group).sum()) == cfg.n
+
+
+def test_grid_kernel_resume_bit_identical():
+    """Stopping after 17 ticks and resuming matches one uninterrupted
+    run (the clock lives in the state; chunk boundaries are free)."""
+    cfg = _cfg("ramp_fail", 64)
+    sched = make_overlay_schedule(cfg)
+    state = init_overlay_state(cfg)
+    mid, _ = make_grid_run(cfg, 17, block_rows=BLOCK)(state, sched)
+    final_split, _ = make_grid_run(cfg, 23, block_rows=BLOCK)(mid, sched)
+    final_once, _ = make_grid_run(cfg, 40, block_rows=BLOCK)(state, sched)
+    for name in STATE_FIELDS:
+        assert np.array_equal(np.asarray(getattr(final_split, name)),
+                              np.asarray(getattr(final_once, name))), name
+
+
+def test_grid_plane_roundtrip():
+    """pack -> unpack is the identity on a mid-run state."""
+    cfg = _cfg("churn", 64)
+    sched = make_overlay_schedule(cfg)
+    state = init_overlay_state(cfg)
+    mid, _ = make_overlay_run(cfg, 30, use_pallas=False)(state, sched)
+    back = unpack_grid_plane(cfg, pack_grid_plane(cfg, mid), mid.tick)
+    for name in STATE_FIELDS:
+        assert np.array_equal(np.asarray(getattr(mid, name)),
+                              np.asarray(getattr(back, name))), name
+
+
+def test_grid_supported_envelope():
+    assert grid_supported(_cfg("churn", 64))
+    # the grid path covers the sizes the VMEM megakernel cannot
+    big = SimConfig(max_nnb=1 << 14, model="overlay",
+                    single_failure=True, drop_msg=False,
+                    total_ticks=100, step_rate=40.0 / (1 << 14))
+    assert grid_supported(big)
+    # a user-set view width that overflows the 128-lane packed plane
+    wide = SimConfig(max_nnb=64, model="overlay", single_failure=True,
+                     drop_msg=False, total_ticks=100, step_rate=0.5,
+                     overlay_view=65)
+    assert not grid_supported(wide)
