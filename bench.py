@@ -90,29 +90,50 @@ def _roofline(cfg, ticks_per_s: float, backend: str) -> dict:
       so mxu_util is also estimated.
     """
     from gossip_protocol_tpu.models.overlay import resolved_dims
+    from gossip_protocol_tpu.models.overlay_grid import grid_supported
     from gossip_protocol_tpu.models.overlay_mega import (MEGA_TICKS,
                                                          mega_supported)
     n = cfg.n
     out = {}
     if cfg.model == "overlay":
-        _, f = resolved_dims(cfg)
+        k, f = resolved_dims(cfg)
         plane = n * 128 * 4                       # (N, <=128 lanes) i32
         if mega_supported(cfg) and backend == "tpu":
             bytes_per_tick = 2 * plane / MEGA_TICKS
             out["path"] = "mega"
             out["bound"] = "vpu/vmem + in-kernel sequencing"
+        elif grid_supported(cfg) and backend == "tpu":
+            # grid multi-tick kernel: per tick each row block reads
+            # its own packed plane block once plus F XOR-partner
+            # blocks and writes once — full PLANE_W=128-lane padded
+            # blocks (Mosaic DMA slices are tile-width)
+            bytes_per_tick = plane * (2 + f)
+            out["path"] = "grid"
+            out["bound"] = "hbm + in-kernel vpu"
         else:
             bytes_per_tick = plane * ((1 + f) * 2 + 3)
             out["path"] = "fused"
             out["bound"] = "hbm + per-launch dispatch"
     else:
+        from gossip_protocol_tpu.core.dense_mega import dense_mega_supported
         cell = n * n
-        # hb/ts i32 + known/gossip i8, read+write once (XLA fuses the
-        # elementwise chain); recv mask read
-        bytes_per_tick = cell * (4 + 4 + 1 + 1) * 2 + cell
-        out["path"] = "dense"
-        out["bound"] = "mxu merge + per-tick dispatch"
         flops_per_tick = 3 * 3 * 2 * n ** 3       # 3 reductions x ~3 levels
+        if dense_mega_supported(cfg) and backend == "tpu":
+            # bench mode runs the dense megakernel (core/tick.py): the
+            # four (N, N) planes live in VMEM across a 16-tick launch,
+            # HBM sees planes in + out once per launch plus the
+            # precomputed (S, N, N) drop stack read once
+            from gossip_protocol_tpu.ops.pallas.dense_mega import \
+                DENSE_MEGA_TICKS
+            bytes_per_tick = cell * 4 * (4 * 2 / DENSE_MEGA_TICKS + 1)
+            out["path"] = "dense-mega"
+            out["bound"] = "in-kernel mxu merge + vpu sequencing"
+        else:
+            # hb/ts i32 + known/gossip i8, read+write once (XLA fuses
+            # the elementwise chain); recv mask read
+            bytes_per_tick = cell * (4 + 4 + 1 + 1) * 2 + cell
+            out["path"] = "dense"
+            out["bound"] = "mxu merge + per-tick dispatch"
         out["mxu_util"] = round(flops_per_tick * ticks_per_s
                                 / V5E_MXU_FLOPS, 4)
     out["hbm_bytes_per_tick"] = int(bytes_per_tick)
@@ -121,16 +142,107 @@ def _roofline(cfg, ticks_per_s: float, backend: str) -> dict:
     return out
 
 
+#: boundary-walk coverage validation runs below this N (the int8
+#: one-hot histogram is O(N*K*(N/256+256)) — fine to 2^17; the 1M
+#: config keeps final-snapshot + continuation validation)
+WALK_COVERAGE_N_LIMIT = 1 << 17
+
+
+def _walk_recover(cfg, sched, length):
+    """Assert the re-cover bound directly, at every occurrence.
+
+    Replays the (bit-identical, closed-form-scheduled) run in
+    GRID_TICKS segments, sampling live coverage at every launch
+    boundary with the scatter-free histogram
+    (models/overlay.covered_histogram).  Whenever a boundary snapshot
+    leaves live members uncovered, the walk drops to tick-by-tick
+    stepping and requires every one of them covered again within
+    ``SLOT_EPOCH + 1`` ticks (tests/test_overlay.py::test_recover_bound
+    — the boosted self-reseed plus the slot re-roll retire any
+    contention hole).  This replaces the post-hoc endpoint continuation
+    at the scales the overlay exists for: coverage is now *observed*
+    during the run, not assumed from a final snapshot.
+
+    Runs outside the timed region; the timed trajectory is identical
+    bit-for-bit (same seed, same closed-form schedule)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gossip_protocol_tpu.config import INTRODUCER
+    from gossip_protocol_tpu.models.overlay import (SLOT_EPOCH,
+                                                    covered_histogram,
+                                                    init_overlay_state,
+                                                    make_overlay_run)
+    from gossip_protocol_tpu.ops.pallas.overlay_grid import GRID_TICKS
+
+    n = cfg.n
+    bound = SLOT_EPOCH + 1
+    rows = jnp.arange(n, dtype=jnp.int32)
+
+    @jax.jit
+    def uncovered_mask(state):
+        cov = covered_histogram(state.ids, n)
+        t = state.tick
+        fail = sched.fail_of(rows)
+        rejoin = sched.rejoin_of(rows)
+        failed = (t > fail) & (t <= rejoin)
+        live = state.in_group & ~failed & (rows != INTRODUCER)
+        return live & ~cov
+
+    state = init_overlay_state(cfg)
+    # tick-by-tick stepping uses the XLA path: bit-identical to the
+    # kernel paths (differential suites) and avoids compiling a
+    # 1-tick grid-kernel variant mid-validation
+    step1 = make_overlay_run(cfg, 1, use_pallas=False)
+    t, pending, holes = 0, None, 0
+    while t < length or pending is not None:
+        if pending is None:
+            seg = min(GRID_TICKS, length - t)
+            state, _ = make_overlay_run(cfg, seg)(state, sched)
+            t += seg
+            unc = np.asarray(uncovered_mask(state))
+            if unc.any():
+                pending = (unc, t + bound)
+                holes += 1
+        else:
+            if t + 1 > 4094:
+                raise RuntimeError(
+                    "overlay bench: coverage walk cannot step past the "
+                    "4094-tick packed-payload cap")
+            state, _ = step1(state, sched)
+            t += 1
+            # narrow the pending set monotonically: a member that
+            # re-covers has satisfied this hole's bound — if it goes
+            # uncovered again later that is a NEW hole with a fresh
+            # deadline (judged at the next boundary), not a violation
+            # of this one
+            mask = pending[0] & np.asarray(uncovered_mask(state))
+            if not mask.any():
+                pending = None
+            elif t >= pending[1]:
+                raise RuntimeError(
+                    f"overlay bench: live members "
+                    f"{np.flatnonzero(mask)[:5].tolist()} stayed "
+                    f"uncovered past the {bound}-tick re-cover bound "
+                    f"(hole observed at the tick-{pending[1] - bound} "
+                    "launch boundary)")
+            else:
+                pending = (mask, pending[1])
+    return holes
+
+
 def _check_recover(cfg, result):
     """No live member may stay uncovered past the re-cover bound.
 
     A final-snapshot coverage hole can be a benign transient: a
-    degree-1 leaf whose boosted self-entry lost one slot contention.
-    The protocol property (tests/test_overlay.py::test_recover_bound)
-    is that the boosted self-reseed plus the SLOT_EPOCH re-roll
+    degree-1 leaf whose self-entry lost one slot contention.  The
+    protocol property (tests/test_overlay.py::test_recover_bound)
+    is that the direct self-reseed plus the SLOT_EPOCH re-roll
     re-covers any live member within ``SLOT_EPOCH + 1`` ticks — the
-    re-roll retires the losing collision pair and the next send's
-    saturated-tie self-entry wins a slot.  Continue the run — with the
+    re-roll and the per-tick partner re-draw retire the colliding
+    pair, and the next send's freshness-majorized self-entry (maximal
+    ts at merge time) wins a slot.  Continue the run — with the
     ORIGINAL schedule pinned, so churn-mode continuations replay the
     exact same fail/rejoin script — for that bound and require every
     snapshot-uncovered member to be covered again.
@@ -154,6 +266,15 @@ def _check_recover(cfg, result):
     before = result.uncovered_members()
     bound = SLOT_EPOCH + 1
     n = cfg.n
+    # the packed (ts+1) << 12 winner payload caps the absolute clock at
+    # 4094 ticks (models/overlay.py); the continuation below runs past
+    # cfg.total_ticks, so the bound must still fit under the cap
+    t_now = int(np.asarray(result.final_state.tick))
+    if t_now + bound > 4094:
+        raise RuntimeError(
+            f"overlay bench: cannot run the {bound}-tick re-cover "
+            f"continuation from tick {t_now} without exceeding the "
+            "4094-tick packed-payload cap; shorten total_ticks")
     run1 = make_overlay_run(cfg, 1)
 
     @jax.jit
@@ -226,7 +347,17 @@ def bench_overlay(n: int, ticks: int, mode: str = "churn",
         raise RuntimeError("overlay bench: join/rejoin incomplete")
     if int(np.asarray(m.victim_slots)[-1]) != 0:
         raise RuntimeError("overlay bench: victims not purged")
-    _check_recover(best.cfg, best)
+    if n <= WALK_COVERAGE_N_LIMIT:
+        # direct in-run assertion of the re-cover bound at every
+        # launch boundary (the 65k-scale validation; it covers final
+        # coverage too — the last boundary IS the final state); above
+        # the walk limit fall back to snapshot + endpoint continuation
+        _walk_recover(best.cfg, best.sched, best.cfg.total_ticks)
+        _, victims_left = best.final_coverage()
+        if victims_left:
+            raise RuntimeError("overlay bench: victim entries left")
+    else:
+        _check_recover(best.cfg, best)
     return best
 
 
@@ -272,12 +403,14 @@ def main():
     # overlay runs need the full churn cycle to finish so the
     # validation can require complete rejoin: lo + span + rejoin + slack
     # = T/4 + T/2 + 40 + 25 <= T  =>  T >= 260
+    # overlay tick counts are multiples of GRID_TICKS=16 so the grid
+    # path compiles one kernel variant per config (no remainder launch)
     if smoke:
-        n_overlay, t_overlay, n_dense, t_dense = 1024, 280, 64, 100
+        n_overlay, t_overlay, n_dense, t_dense = 1024, 288, 64, 100
     elif backend == "cpu":
-        n_overlay, t_overlay, n_dense, t_dense = 2048, 280, 512, 200
+        n_overlay, t_overlay, n_dense, t_dense = 2048, 288, 512, 200
     else:
-        n_overlay, t_overlay, n_dense, t_dense = 65536, 300, 512, 700
+        n_overlay, t_overlay, n_dense, t_dense = 65536, 304, 512, 700
 
     overlay = bench_overlay(n_overlay, t_overlay)
     n_drop = min(4096, n_overlay)              # BASELINE "4096, 10% drop"
@@ -302,7 +435,7 @@ def main():
         secondary["node_ticks_per_s_n4096_fullview"] = round(dense4k, 1)
         # BASELINE's 1M north-star shape: power-law overlay, validated
         # (join completeness, victim purge, live coverage)
-        pl_1m = bench_overlay(1 << 20, 260, mode="fail",
+        pl_1m = bench_overlay(1 << 20, 272, mode="fail",
                               topology="powerlaw")
         secondary["n1048576_overlay_powerlaw"] = _overlay_entry(pl_1m,
                                                                 backend)
