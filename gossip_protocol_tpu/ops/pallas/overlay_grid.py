@@ -155,9 +155,10 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
                                    _pack_key, _pack_th, _slot_of)
     from ...utils.hash32 import mix32
 
-    own_scr = refs[0]
-    part_scrs = refs[1:1 + f_rounds]
-    bc_cur, bc_nxt, q_cur, q_nxt, sems = refs[1 + f_rounds:]
+    own_bank = refs[0]                  # (2, B, W) double-banked
+    part_banks = refs[1:1 + f_rounds]   # (2, B, W) each
+    (bc_cur, bc_nxt, q_cur, q_nxt, ld_sems, st_sems) = \
+        refs[1 + f_rounds:]
 
     i32 = jnp.int32
     w = 2 * k                # data lanes; the plane is padded to PLANE_W
@@ -174,30 +175,76 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
     ns = _GSP_NSCALARS + max(f_rounds - 1, 0)      # masks offset
     masks = [sp_ref[ns + s * f_rounds + fi] for fi in range(f_rounds)]
 
-    # ---- DMA in: own block + F XOR-partner blocks ------------------
-    # (tick 0 reads the init input; later ticks read the previous
-    # tick's committed phase.  The waits use size-matched descriptors:
-    # both sources transfer identical byte counts.)
-    def start_load(dst, row0, sem):
-        @pl.when(s == 0)
-        def _():
-            pltpu.make_async_copy(init_in.at[pl.ds(row0, b), :],
-                                  dst, sem).start()
+    # ---- DMA in: banked prefetch ------------------------------------
+    # Loads for step e = s*nb + i are issued one step AHEAD into bank
+    # e%2 (hiding the HBM DMA latency behind step e-1's compute), except
+    # at tick boundaries: a tick's first step must not read phase
+    # 1-s%2 rows before the previous tick's deferred stores drain, so
+    # it drains both store semaphores and issues its own loads inline.
+    # Waits use size-matched descriptors (both sources transfer
+    # identical byte counts).
+    nb = n // b
+    e_par = jax.lax.rem(s * nb + i, 2)             # this step's bank
 
-        @pl.when(s > 0)
-        def _():
-            pltpu.make_async_copy(plane_out.at[phase, pl.ds(row0, b), :],
-                                  dst, sem).start()
+    def issue_loads(s_e, i_e, bank):
+        """Start the (1+F) block loads of step (s_e, i_e) into bank."""
+        masks_e = [sp_ref[ns + s_e * f_rounds + fi]
+                   for fi in range(f_rounds)]
+        phase_e = jax.lax.rem(s_e, 2)
+        rows_e = [i_e * b] + [(i_e ^ (masks_e[fi] // b)) * b
+                              for fi in range(f_rounds)]
+        dsts = [own_bank.at[bank]] + [part_banks[fi].at[bank]
+                                      for fi in range(f_rounds)]
+        for j, (row0, dst) in enumerate(zip(rows_e, dsts)):
+            @pl.when(s_e == 0)
+            def _(row0=row0, dst=dst, j=j):
+                pltpu.make_async_copy(init_in.at[pl.ds(row0, b), :],
+                                      dst, ld_sems.at[bank, j]).start()
 
-    start_load(own_scr, i * b, sems.at[0])
-    for fi in range(f_rounds):
-        pblk = i ^ (masks[fi] // b)
-        start_load(part_scrs[fi], pblk * b, sems.at[1 + fi])
-    pltpu.make_async_copy(init_in.at[pl.ds(0, b), :], own_scr,
-                          sems.at[0]).wait()
-    for fi in range(f_rounds):
-        pltpu.make_async_copy(init_in.at[pl.ds(0, b), :], part_scrs[fi],
-                              sems.at[1 + fi]).wait()
+            @pl.when(s_e > 0)
+            def _(row0=row0, dst=dst, j=j):
+                pltpu.make_async_copy(
+                    plane_out.at[phase_e, pl.ds(row0, b), :],
+                    dst, ld_sems.at[bank, j]).start()
+
+    def wait_loads(bank):
+        for j in range(1 + f_rounds):
+            dst = own_bank.at[bank] if j == 0 \
+                else part_banks[j - 1].at[bank]
+            pltpu.make_async_copy(init_in.at[pl.ds(0, b), :], dst,
+                                  ld_sems.at[bank, j]).wait()
+
+    def wait_store(bank):
+        pltpu.make_async_copy(
+            own_bank.at[bank],
+            plane_out.at[0, pl.ds(0, b), :], st_sems.at[bank]).wait()
+
+    @pl.when((i == 0) & (s > 0))
+    def _():
+        # tick boundary: drain the previous tick's deferred stores
+        # (both banks when its tail held two in flight)
+        wait_store(1 - e_par)
+        if nb > 1:
+            wait_store(e_par)
+
+    @pl.when(i == 0)
+    def _():
+        issue_loads(s, i, e_par)           # not prefetched (boundary)
+    wait_loads(e_par)
+
+    @pl.when((i + 1 < nb) & (i > 0))
+    def _():
+        # the store issued last step used bank 1-e_par's scratch;
+        # drain it before the prefetch overwrites that bank
+        wait_store(1 - e_par)
+
+    @pl.when(i + 1 < nb)
+    def _():
+        issue_loads(s, i + 1, 1 - e_par)
+
+    # all compute below operates on this step's bank
+    own_scr = own_bank.at[e_par]
+    part_scrs = [part_banks[fi].at[e_par] for fi in range(f_rounds)]
 
     # ---- tick-boundary revolves (first block of each tick) ---------
     @pl.when((i == 0) & (s == 0))
@@ -206,7 +253,7 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
         # N+1 the JOINREQ aggregate (ANY-space input, so DMA through
         # the bc scratch; the store semaphore is idle here)
         cp = pltpu.make_async_copy(init_in.at[pl.ds(n, 8), :], bc_cur,
-                                   sems.at[1 + f_rounds])
+                                   st_sems.at[0])
         cp.start()
         cp.wait()
         q_cur[0:1, :] = bc_cur[1:2, 0:k]
@@ -501,11 +548,18 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
         bc_nxt[0:1, :] = own_scr[INTRODUCER % b:INTRODUCER % b + 1, :]
 
     # ---- DMA out: commit the block to the next phase ---------------
-    st = pltpu.make_async_copy(
+    # deferred: the wait happens when this bank's scratch is next
+    # reused (prefetch / tick-boundary drain), hiding the store
+    # latency behind the following step's compute
+    pltpu.make_async_copy(
         own_scr, plane_out.at[1 - phase, pl.ds(i * b, b), :],
-        sems.at[1 + f_rounds])
-    st.start()
-    st.wait()
+        st_sems.at[e_par]).start()
+
+    @pl.when((s == s_ticks - 1) & (i == nb - 1))
+    def _():
+        wait_store(e_par)                  # drain before kernel exit
+        if nb > 1:
+            wait_store(1 - e_par)
 
 
 @functools.partial(
@@ -554,11 +608,12 @@ def grid_overlay_ticks(init, sp, *, n: int, k: int, f_rounds: int,
             pl.BlockSpec((s_ticks, 128), lambda s, i, sp: (0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        scratch_shapes=[pltpu.VMEM((b, PLANE_W), i32)
+        scratch_shapes=[pltpu.VMEM((2, b, PLANE_W), i32)
                         for _ in range(1 + f_rounds)]
         + [pltpu.VMEM((8, PLANE_W), i32), pltpu.VMEM((8, PLANE_W), i32),
            pltpu.VMEM((8, k), i32), pltpu.VMEM((8, k), i32),
-           pltpu.SemaphoreType.DMA((f_rounds + 2,))],
+           pltpu.SemaphoreType.DMA((2, f_rounds + 1)),
+           pltpu.SemaphoreType.DMA((2,))],
     )
     plane2, met = pl.pallas_call(
         functools.partial(_kernel, n, k, f_rounds, s_ticks, b, t_remove,
