@@ -429,6 +429,12 @@ def main():
         "fullview_vs_baseline": round(dense / REFERENCE_NODE_TICKS_PER_S, 3),
     }
     if backend == "tpu" and not smoke:
+        # the (4096, 65536] envelope: the grid multi-tick kernel's
+        # smallest headline size (was the unrecorded fallback gap)
+        mid = bench_overlay(8192, t_overlay)
+        secondary["n8192_overlay_churn20"] = _overlay_entry(mid, backend)
+        secondary["node_ticks_per_s_n8192_overlay_churn20"] = \
+            round(mid.node_ticks_per_second, 1)
         # dense full-view at the BASELINE "N=4096, 10% drop" shape
         dense4k_cfg, dense4k = bench_dense(4096, 200)
         secondary["n4096_fullview"] = _entry(dense4k_cfg, dense4k, backend)

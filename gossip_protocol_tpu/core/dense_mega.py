@@ -32,9 +32,13 @@ def dense_mega_supported(cfg: SimConfig) -> bool:
     return 16 <= cfg.n <= DENSE_MEGA_N_LIMIT and cfg.n % 8 == 0
 
 
-def make_dense_mega_run(cfg: SimConfig):
-    """``run(state, sched) -> (final, TickEvents)`` over the whole run
-    (bench mode: sent/recv counters only, no event masks)."""
+def make_dense_mega_run(cfg: SimConfig, with_events: bool = False):
+    """``run(state, sched) -> (final, TickEvents)`` over the whole run.
+
+    ``with_events=False`` is bench mode (sent/recv counters only);
+    ``with_events=True`` also returns the full (T, N, N) added/removed
+    masks, emitted per tick by the kernel itself — the graded
+    trace-mode path rides the same megakernel."""
     from .tick import TickEvents
     assert dense_mega_supported(cfg)
     n = cfg.n
@@ -42,7 +46,8 @@ def make_dense_mega_run(cfg: SimConfig):
     s_full = DENSE_MEGA_TICKS
     n_chunks, rem = divmod(total, s_full)
     can_rejoin = cfg.rejoin_after is not None
-    kern_kw = dict(n=n, t_remove=cfg.t_remove, can_rejoin=can_rejoin)
+    kern_kw = dict(n=n, t_remove=cfg.t_remove, can_rejoin=can_rejoin,
+                   with_events=with_events)
 
     def drop_stack(rng, t0, s_ticks, sched: Schedule):
         ts = t0 + jnp.arange(s_ticks, dtype=jnp.int32)
@@ -73,18 +78,26 @@ def make_dense_mega_run(cfg: SimConfig):
         g, q, p = drop_stack(state_rng, t, s_ticks, sched)
         sp = jnp.reshape(t, (1,)).astype(jnp.int32)
         known, hb, ts, gossip = planes
-        known, hb, ts, gossip, aux, sent, recv = dense_mega_ticks(
+        out = dense_mega_ticks(
             known, hb, ts, gossip, aux, g, q, p, sp,
             s_ticks=s_ticks, **kern_kw)
-        return (known, hb, ts, gossip), aux, t + s_ticks, sent, recv
+        known, hb, ts, gossip, aux, sent, recv = out[:7]
+        ev = out[7:] if with_events else (None, None)
+        return (known, hb, ts, gossip), aux, t + s_ticks, sent, recv, ev
 
-    def assemble(planes, aux, t, rng, sents, recvs):
+    def assemble(planes, aux, t, rng, sents, recvs, addeds, removeds):
         sent = jnp.concatenate(sents, 0) if sents \
             else jnp.zeros((0, n), jnp.int32)
         recv = jnp.concatenate(recvs, 0) if recvs \
             else jnp.zeros((0, n), jnp.int32)
-        zeros_t = jnp.zeros((sent.shape[0],), bool)
-        ev = TickEvents(added=zeros_t, removed=zeros_t,
+        if with_events:
+            added = jnp.concatenate(addeds, 0) > 0 if addeds \
+                else jnp.zeros((0, n, n), bool)
+            removed = jnp.concatenate(removeds, 0) > 0 if removeds \
+                else jnp.zeros((0, n, n), bool)
+        else:
+            added = removed = jnp.zeros((sent.shape[0],), bool)
+        ev = TickEvents(added=added, removed=removed,
                         sent=sent, recv=recv)
         return unpack(planes, aux, t, rng), ev
 
@@ -92,23 +105,31 @@ def make_dense_mega_run(cfg: SimConfig):
         planes0 = pack(state, sched)
         planes, aux = planes0[:4], planes0[4]
         t = state.tick
-        sents, recvs = [], []
+        sents, recvs, addeds, removeds = [], [], [], []
         if n_chunks:
             def step(carry, _):
                 planes, aux, t = carry
-                planes, aux, t, sent, recv = launch(
+                planes, aux, t, sent, recv, ev = launch(
                     planes, aux, t, state.rng, sched, s_full)
-                return (planes, aux, t), (sent, recv)
-            (planes, aux, t), (sent_m, recv_m) = jax.lax.scan(
+                out = (sent, recv) + (ev if with_events else ())
+                return (planes, aux, t), out
+            (planes, aux, t), outs = jax.lax.scan(
                 step, (planes, aux, t), None, length=n_chunks)
-            sents.append(sent_m.reshape(n_chunks * s_full, n))
-            recvs.append(recv_m.reshape(n_chunks * s_full, n))
+            sents.append(outs[0].reshape(n_chunks * s_full, n))
+            recvs.append(outs[1].reshape(n_chunks * s_full, n))
+            if with_events:
+                addeds.append(outs[2].reshape(n_chunks * s_full, n, n))
+                removeds.append(outs[3].reshape(n_chunks * s_full, n, n))
         if rem:
-            planes, aux, t, sent_r, recv_r = launch(
+            planes, aux, t, sent_r, recv_r, ev_r = launch(
                 planes, aux, t, state.rng, sched, rem)
             sents.append(sent_r)
             recvs.append(recv_r)
-        return assemble(planes, aux, t, state.rng, sents, recvs)
+            if with_events:
+                addeds.append(ev_r[0])
+                removeds.append(ev_r[1])
+        return assemble(planes, aux, t, state.rng, sents, recvs,
+                        addeds, removeds)
 
     if jax.default_backend() == "tpu":
         return jax.jit(run_body, compiler_options={
@@ -118,17 +139,16 @@ def make_dense_mega_run(cfg: SimConfig):
         planes0 = pack(state, sched)
         planes, aux = planes0[:4], planes0[4]
         t = state.tick
-        sents, recvs = [], []
-        for _ in range(n_chunks):
-            planes, aux, t, sent, recv = launch(planes, aux, t,
-                                                state.rng, sched, s_full)
+        sents, recvs, addeds, removeds = [], [], [], []
+        for s_ticks in [s_full] * n_chunks + ([rem] if rem else []):
+            planes, aux, t, sent, recv, ev = launch(
+                planes, aux, t, state.rng, sched, s_ticks)
             sents.append(sent)
             recvs.append(recv)
-        if rem:
-            planes, aux, t, sent, recv = launch(planes, aux, t,
-                                                state.rng, sched, rem)
-            sents.append(sent)
-            recvs.append(recv)
-        return assemble(planes, aux, t, state.rng, sents, recvs)
+            if with_events:
+                addeds.append(ev[0])
+                removeds.append(ev[1])
+        return assemble(planes, aux, t, state.rng, sents, recvs,
+                        addeds, removeds)
 
     return run_eager

@@ -73,6 +73,26 @@ def test_dense_megakernel_odd_length_chunks():
     assert np.array_equal(np.asarray(ex.sent), np.asarray(em.sent))
 
 
+@pytest.mark.parametrize("scenario", ["single", "multi", "drop", "churn"])
+def test_dense_megakernel_events_equal_xla(scenario):
+    """Trace mode: the kernel-emitted added/removed masks match the
+    per-tick XLA path's TickEvents exactly (the graded dbg.log path
+    rides the megakernel — VERDICT round-4 task 4)."""
+    cfg = _cfg(scenario).replace(total_ticks=57)   # remainder launch too
+    sched = make_schedule(cfg)
+    state = init_state(cfg)
+    fx, ex = make_run(cfg, with_events=True, use_pallas=False)(state, sched)
+    fm, em = make_dense_mega_run(cfg, with_events=True)(state, sched)
+    for name in STATE_FIELDS:
+        assert np.array_equal(np.asarray(getattr(fx, name)),
+                              np.asarray(getattr(fm, name))), name
+    for name in ("added", "removed", "sent", "recv"):
+        a, b = np.asarray(getattr(ex, name)), np.asarray(getattr(em, name))
+        assert np.array_equal(a, b), \
+            f"{name} diverged at ticks " \
+            f"{np.flatnonzero((a != b).reshape(a.shape[0], -1).any(1))[:5]}"
+
+
 def test_dense_mega_envelope():
     assert dense_mega_supported(_cfg("single", 64))
     assert dense_mega_supported(_cfg("single", 512))
