@@ -988,7 +988,9 @@ def make_overlay_run(cfg: SimConfig, length: int | None = None,
             and jax.default_backend() == "tpu")
     key = (cfg.n, cfg.t_remove, length, resolved_dims(cfg), use_pallas,
            cfg.topology, cfg.total_ticks, mega, grid,
-           cfg.churn_rate > 0 or cfg.rejoin_after is not None)
+           cfg.churn_rate > 0 or cfg.rejoin_after is not None,
+           # the grid kernel bakes churn-vs-scripted statically
+           cfg.churn_rate > 0)
     if key in _OVERLAY_RUN_CACHE:
         return _OVERLAY_RUN_CACHE[key]
     if mega:

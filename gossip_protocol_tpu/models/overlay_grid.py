@@ -168,6 +168,7 @@ def make_grid_run(cfg: SimConfig, length: int,
                    churn_span=max(cfg.total_ticks // 2, 1),
                    can_rejoin=cfg.churn_rate > 0
                    or cfg.rejoin_after is not None,
+                   churn_mode=cfg.churn_rate > 0,
                    powerlaw=cfg.topology == "powerlaw")
 
     def _metrics(met):

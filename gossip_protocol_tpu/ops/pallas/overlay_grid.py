@@ -145,7 +145,8 @@ def unpack_aux_lanes(pwr):
 
 def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
             t_remove: int, churn_lo: int,
-            churn_span: int, never: int, can_rejoin: bool, powerlaw: bool,
+            churn_span: int, never: int, can_rejoin: bool,
+            churn_mode: bool, powerlaw: bool,
             sp_ref, init_in, plane_out, met_out, *refs):
     from ...config import INTRODUCER
     from ...models.overlay import (ID_BITS, ID_MASK, SLOT_EPOCH,
@@ -157,7 +158,7 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
 
     own_bank = refs[0]                  # (2, B, W) double-banked
     part_banks = refs[1:1 + f_rounds]   # (2, B, W) each
-    (bc_cur, bc_nxt, q_cur, q_nxt, ld_sems, st_sems) = \
+    (bc_cur, bc_nxt, q_cur, q_nxt, acc_k, acc_p, ld_sems, st_sems) = \
         refs[1 + f_rounds:]
 
     i32 = jnp.int32
@@ -276,20 +277,23 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
     slot_ep = (t // SLOT_EPOCH).astype(jnp.uint32)
 
     def sched_of(subj):
-        """(fail, rejoin) of subject ids — closed form, any shape."""
-        subj_u = subj.astype(jnp.uint32)
-        churned = (mix32(seed, subj_u, np.uint32(_SALT_CHURN))
-                   < churn_thr) & (subj != INTRODUCER)
-        churn_fail = churn_lo + (
-            mix32(seed, subj_u, np.uint32(_SALT_CHURN_TICK))
-            % np.uint32(churn_span)).astype(i32)
-        scripted = jnp.where(
-            (subj >= sp_ref[_GSP_VLO]) & (subj < sp_ref[_GSP_VHI]),
-            sp_ref[_GSP_FTICK], never)
-        fail = jnp.where(churn_thr > 0,
-                         jnp.where(churned, churn_fail, never), scripted)
-        after = jnp.where(churn_thr > 0, sp_ref[_GSP_CAFTER],
-                          sp_ref[_GSP_RAFTER])
+        """(fail, rejoin) of subject ids — closed form, any shape.
+        ``churn_mode`` is static (cfg.churn_rate > 0), so fail-mode
+        configs never pay the two per-entry churn hashes."""
+        if churn_mode:
+            subj_u = subj.astype(jnp.uint32)
+            churned = (mix32(seed, subj_u, np.uint32(_SALT_CHURN))
+                       < churn_thr) & (subj != INTRODUCER)
+            churn_fail = churn_lo + (
+                mix32(seed, subj_u, np.uint32(_SALT_CHURN_TICK))
+                % np.uint32(churn_span)).astype(i32)
+            fail = jnp.where(churned, churn_fail, never)
+            after = sp_ref[_GSP_CAFTER]
+        else:
+            fail = jnp.where(
+                (subj >= sp_ref[_GSP_VLO]) & (subj < sp_ref[_GSP_VHI]),
+                sp_ref[_GSP_FTICK], never)
+            after = sp_ref[_GSP_RAFTER]
         rejoin = jnp.where((fail != never) & (after != never),
                            fail + after, never)
         return fail, rejoin
@@ -393,50 +397,78 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
                               jnp.where(match, pp, 0))
         recv = recv + ok.astype(i32)
 
-    # ---- JOINREP: the introducer's broadcast view ------------------
-    bcrow = bc_cur[0:1, :]
-    bc_ids = bcrow[:, 0:k]
-    bc_pw, bc_hb, _, _ = unpack_aux_lanes(bcrow[:, k:w])
-    if can_rejoin:                           # wipe-on-load (introducer)
-        rejoining0 = t == rejoin0
-        bc_ids = jnp.where(rejoining0, -1, bc_ids)
-        bc_pw = jnp.where(rejoining0, 0, bc_pw)
-        bc_hb = jnp.where(rejoining0, 0, bc_hb)
-    j_valid = jrep & (bc_ids >= 0) & (bc_pw >= fresh_floor) \
-        & (bc_ids != rows)
-    jkey = jnp.where(j_valid,
-                     ((bc_pw >> 12).astype(jnp.uint32) << ID_BITS)
-                     | bc_ids.astype(jnp.uint32),
-                     jnp.uint32(0))
-    kmax, pacc = _lex(kmax, pacc, jkey, jnp.where(j_valid, bc_pw, 0))
-    if t_remove > 1:                         # the introducer's self-entry
-        intro_vec = jnp.zeros_like(rows) + INTRODUCER
-        islot = _slot_of(seed, slot_ep, intro_vec, k)
-        iok = jrep & ~is_intro
-        ikey = jnp.where(iok, key_t1 | jnp.uint32(INTRODUCER),
-                         jnp.uint32(0))
-        ip = jnp.where(iok, pw_t1 | (bc_hb + 1), 0)
-        imatch = islot == kk
-        kmax, pacc = _lex(kmax, pacc,
-                          jnp.where(imatch, ikey, jnp.uint32(0)),
-                          jnp.where(imatch, ip, 0))
+    # ---- JOINREP + JOINREQ merges (scratch-staged + predicated) ----
+    # Both are rare per block — JOINREPs only reach joining/rejoining
+    # rows and the JOINREQ aggregate only lands in the introducer's
+    # block — so the accumulator revolves through scratch and the ~30
+    # vector ops run under pl.when instead of burning every step.
+    jrep_any = _sum_all(jrep)[0, 0] > 0
+    acc_k[:] = kmax.astype(i32)
+    acc_p[:] = pacc
 
-    # ---- JOINREQ aggregates into the introducer's row --------------
-    q_kf = q_cur[0:1, :].astype(jnp.uint32)
-    q_pf = jnp.where(q_kf > 0, _pack_th(t, 1), 0)
-    kmax, pacc = _lex(kmax, pacc,
-                      jnp.where(is_intro, q_kf, jnp.uint32(0)),
-                      jnp.where(is_intro, q_pf, 0))
+    @pl.when(jrep_any)
+    def _():
+        kmax = acc_k[:].astype(jnp.uint32)
+        pacc = acc_p[:]
+        bcrow = bc_cur[0:1, :]
+        bc_ids = bcrow[:, 0:k]
+        bc_pw, bc_hb, _, _ = unpack_aux_lanes(bcrow[:, k:w])
+        if can_rejoin:                       # wipe-on-load (introducer)
+            rejoining0 = t == rejoin0
+            bc_ids = jnp.where(rejoining0, -1, bc_ids)
+            bc_pw = jnp.where(rejoining0, 0, bc_pw)
+            bc_hb = jnp.where(rejoining0, 0, bc_hb)
+        j_valid = jrep & (bc_ids >= 0) & (bc_pw >= fresh_floor) \
+            & (bc_ids != rows)
+        jkey = jnp.where(j_valid,
+                         ((bc_pw >> 12).astype(jnp.uint32) << ID_BITS)
+                         | bc_ids.astype(jnp.uint32),
+                         jnp.uint32(0))
+        kmax, pacc = _lex(kmax, pacc, jkey, jnp.where(j_valid, bc_pw, 0))
+        if t_remove > 1:                     # the introducer's self-entry
+            intro_vec = jnp.zeros_like(rows) + INTRODUCER
+            islot = _slot_of(seed, slot_ep, intro_vec, k)
+            iok = jrep & ~is_intro
+            ikey = jnp.where(iok, key_t1 | jnp.uint32(INTRODUCER),
+                             jnp.uint32(0))
+            ip = jnp.where(iok, pw_t1 | (bc_hb + 1), 0)
+            imatch = islot == kk
+            kmax, pacc = _lex(kmax, pacc,
+                              jnp.where(imatch, ikey, jnp.uint32(0)),
+                              jnp.where(imatch, ip, 0))
+        acc_k[:] = kmax.astype(i32)
+        acc_p[:] = pacc
+
+    @pl.when(i == INTRODUCER // b)
+    def _():
+        kmax = acc_k[:].astype(jnp.uint32)
+        pacc = acc_p[:]
+        q_kf = q_cur[0:1, :].astype(jnp.uint32)
+        q_pf = jnp.where(q_kf > 0, _pack_th(t, 1), 0)
+        kmax, pacc = _lex(kmax, pacc,
+                          jnp.where(is_intro, q_kf, jnp.uint32(0)),
+                          jnp.where(is_intro, q_pf, 0))
+        acc_k[:] = kmax.astype(i32)
+        acc_p[:] = pacc
+
+    kmax = acc_k[:].astype(jnp.uint32)
+    pacc = acc_p[:]
     jreq = joinreq0 & proc0
 
     # ---- winner extraction + staleness detection -------------------
-    ids1 = jnp.where(kmax > 0,
-                     (kmax & jnp.uint32(ID_MASK)).astype(i32), -1)
-    ts1 = jnp.where(kmax > 0, (pacc >> 12) - 1, 0)
-    hb1 = jnp.where(kmax > 0, (pacc & 0xFFF) - 1, 0)
-    stale = (ids1 >= 0) & (t - ts1 >= t_remove) & ops
+    # the key IS (ts+1, id) and pacc IS the winner's packed pw word,
+    # so occupancy and staleness are single uint compares on kmax:
+    # occupied <=> kmax > 0; stale <=> ts <= t - t_remove <=>
+    # kmax < (t - t_remove + 2) << ID_BITS
+    occ1 = kmax > 0
+    ids1 = jnp.where(occ1, (kmax & jnp.uint32(ID_MASK)).astype(i32), -1)
+    # clamp before the uint cast: early in the run t - t_remove + 2 is
+    # negative and would wrap to a huge ceiling (everything "stale")
+    stale_ceil = (jnp.maximum(t - t_remove + 2, 0).astype(jnp.uint32)
+                  << ID_BITS)
+    stale = occ1 & (kmax < stale_ceil) & ops
     ids2 = jnp.where(stale, -1, ids1)
-    pw2 = jnp.where(stale | (ids1 < 0), 0, _pack_th(ts1, hb1))
+    pw2 = jnp.where(stale | ~occ1, 0, pacc)
 
     # subject fail/rejoin for the accuracy metrics
     subj = jnp.where(ids1 >= 0, ids1, 0)
@@ -518,8 +550,11 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
         key = jnp.where(idsv >= 0, _pack_key(idsv, tsv),
                         jnp.uint32(0))
 
-        # pairwise lex-max reduction tree over the K source slots
-        # (associative + commutative; see overlay_mega.py phase C)
+        # pairwise max-reduction tree over the K source slots.  A
+        # row's candidate keys are pairwise DISTINCT (one entry per
+        # id, and the key embeds the id), so the payload lex-compare
+        # of the generic merge is redundant: max on the key alone and
+        # carry the payload by the same select
         def cand_slot(j):
             match = tgt[:, j:j + 1] == kk
             return (jnp.where(match, key[:, j:j + 1], jnp.uint32(0)),
@@ -531,7 +566,9 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
             mid = (lo + hi) // 2
             ka, pa = reduce_slots(lo, mid)
             kb, pb = reduce_slots(mid, hi)
-            return _lex(ka, pa, kb, pb)
+            better = kb > ka
+            return (jnp.where(better, kb, ka),
+                    jnp.where(better, pb, pa))
 
         kf, pf = reduce_slots(0, k)
         ids_r = jnp.where(kf > 0,
@@ -566,11 +603,12 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
     jax.jit, static_argnames=("n", "k", "f_rounds", "s_ticks", "b",
                               "t_remove",
                               "churn_lo", "churn_span", "can_rejoin",
-                              "powerlaw", "interpret"))
+                              "churn_mode", "powerlaw", "interpret"))
 def grid_overlay_ticks(init, sp, *, n: int, k: int, f_rounds: int,
                        s_ticks: int, b: int, t_remove: int,
                        churn_lo: int,
-                       churn_span: int, can_rejoin: bool, powerlaw: bool,
+                       churn_span: int, can_rejoin: bool,
+                       churn_mode: bool, powerlaw: bool,
                        interpret: bool | None = None):
     """Run ``s_ticks`` whole overlay ticks in one grid-scale launch.
 
@@ -612,13 +650,14 @@ def grid_overlay_ticks(init, sp, *, n: int, k: int, f_rounds: int,
                         for _ in range(1 + f_rounds)]
         + [pltpu.VMEM((8, PLANE_W), i32), pltpu.VMEM((8, PLANE_W), i32),
            pltpu.VMEM((8, k), i32), pltpu.VMEM((8, k), i32),
+           pltpu.VMEM((b, k), i32), pltpu.VMEM((b, k), i32),
            pltpu.SemaphoreType.DMA((2, f_rounds + 1)),
            pltpu.SemaphoreType.DMA((2,))],
     )
     plane2, met = pl.pallas_call(
         functools.partial(_kernel, n, k, f_rounds, s_ticks, b, t_remove,
                           churn_lo, churn_span,
-                          int(NEVER), can_rejoin, powerlaw),
+                          int(NEVER), can_rejoin, churn_mode, powerlaw),
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct((2, n, PLANE_W), i32),
                    jax.ShapeDtypeStruct((s_ticks, 128), i32)],
