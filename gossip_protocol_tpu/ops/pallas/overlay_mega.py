@@ -136,7 +136,7 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, t_remove: int,
             powerlaw: bool,
             sp_ref, st_in, st_out, met_out, *w_refs):
     from ...config import INTRODUCER
-    from ...models.overlay import (ID_BITS, ID_MASK, SLOT_EPOCH, _SALT_CHURN,
+    from ...models.overlay import (ID_MASK, SLOT_EPOCH, _SALT_CHURN,
                                    _SALT_CHURN_TICK, _SALT_GOSSIP_DROP,
                                    _SALT_JOINREP_DROP, _SALT_JOINREQ_DROP,
                                    _pack_key, _pack_th, _slot_of)
@@ -319,18 +319,14 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, t_remove: int,
                               jnp.where(is_intro, q_kf, jnp.uint32(0)),
                               jnp.where(is_intro, q_pf, 0))
 
-            # winner extraction + staleness detection: the key IS
-            # (ts+1, id) and pacc IS the winner's pw word, so both are
-            # single uint32 compares on kmax (ceiling clamped at 0 so
-            # early ticks don't wrap through the uint cast)
-            occ1 = kmax > 0
-            ids1 = jnp.where(occ1,
+            # winner extraction + staleness detection
+            ids1 = jnp.where(kmax > 0,
                              (kmax & jnp.uint32(ID_MASK)).astype(i32), -1)
-            stale_ceil = (jnp.maximum(t - t_remove + 2, 0)
-                          .astype(jnp.uint32) << ID_BITS)
-            stale = occ1 & (kmax < stale_ceil) & ops
+            ts1 = jnp.where(kmax > 0, (pacc >> 12) - 1, 0)
+            hb1 = jnp.where(kmax > 0, (pacc & 0xFFF) - 1, 0)
+            stale = (ids1 >= 0) & (t - ts1 >= t_remove) & ops
             ids2 = jnp.where(stale, -1, ids1)
-            pw2 = jnp.where(stale | ~occ1, 0, pacc)
+            pw2 = jnp.where(stale | (ids1 < 0), 0, _pack_th(ts1, hb1))
 
             # subject fail/rejoin (closed-form schedule, in-kernel)
             subj = jnp.where(ids1 >= 0, ids1, 0)
@@ -420,30 +416,25 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, t_remove: int,
             key = jnp.where(idsv >= 0, _pack_key(idsv, tsv),
                             jnp.uint32(0))
 
-            # contention resolved by a pairwise KEY-max reduction TREE
-            # over the K source slots (keys are pairwise distinct per
-            # row, so no payload tie-break is needed; max is
-            # associative and commutative).  A sequential K-step chain
-            # compiles the same bits, but XLA:CPU's interpret-mode
-            # compile blows up superlinearly on the K-long dependent
-            # chain (measured: k=16 ~10 s, k=24 >500 s); the tree is
-            # log-depth with O(log K) live (N, K) planes.
+            # contention resolved by a pairwise lex-max reduction TREE
+            # over the K source slots (lex-max is associative and
+            # commutative).  A sequential K-step chain compiles the
+            # same bits, but XLA:CPU's interpret-mode compile blows up
+            # superlinearly on the K-long dependent chain (measured:
+            # k=16 ~10 s, k=24 >500 s); the tree is log-depth with
+            # O(log K) live (N, K) planes.
             def cand(j):
                 match = tgt[:, j:j + 1] == kk_n
                 return (jnp.where(match, key[:, j:j + 1], jnp.uint32(0)),
                         jnp.where(match, pwv[:, j:j + 1], 0))
 
             def reduce_slots(lo, hi):
-                # keys are pairwise distinct per row (one entry per
-                # id; the key embeds the id), so max on the key alone
                 if hi - lo == 1:
                     return cand(lo)
                 mid = (lo + hi) // 2
                 ka, pa = reduce_slots(lo, mid)
                 kb, pb = reduce_slots(mid, hi)
-                better = kb > ka
-                return (jnp.where(better, kb, ka),
-                        jnp.where(better, pb, pa))
+                return _lex(ka, pa, kb, pb)
 
             kf, pf = reduce_slots(0, k)
             ids_r = jnp.where(kf > 0,
