@@ -47,6 +47,13 @@ def _cfg(scenario, n):
                          drop_msg=False, seed=7, total_ticks=200,
                          churn_rate=0.25, rejoin_after=30,
                          step_rate=40.0 / n)
+    if scenario == "even_fanout":
+        # F=4: two exchange-round pairs, no leftover round — covers
+        # the doubled-lane merge's even case
+        return SimConfig(max_nnb=n, model="overlay", single_failure=False,
+                         drop_msg=False, seed=17, total_ticks=200,
+                         churn_rate=0.25, rejoin_after=30, fanout=4,
+                         step_rate=40.0 / n)
     if scenario == "aged":
         # tiny TREMOVE + a long drop window: entries routinely age to
         # exactly t_remove in a partner's table, exercising the packed
@@ -93,6 +100,7 @@ def _compare(cfg, length):
     ("churn", 64),
     ("powerlaw", 64),
     ("aged", 64),
+    ("even_fanout", 64),
 ])
 def test_grid_kernel_bitwise_equals_xla(scenario, n):
     cfg = _cfg(scenario, n)
