@@ -93,13 +93,15 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
     assert n % comm.n_shards == 0, "peer count must divide the mesh axis"
     # the fused epilogue kernel needs its tile divisibility (row tile
     # 64, sublane-aligned — mirrors the asserts in fused_tick_update)
-    # and bounded VMEM: its row tiles span the full peer axis, and
-    # n = 4096 exceeds the 16 MB scoped-VMEM budget with ~17 live
-    # (TR, N) planes.  Everything else falls back to the composable
-    # ops (which still use the MXU merge when use_pallas is on).
+    # and bounded VMEM: its row tiles span the full peer axis, so the
+    # kernel raises its scoped-VMEM window itself (the old n <= 2048
+    # envelope was the default 16 MB window; n = 8192 would put a
+    # single (TR=64, N) tile set near 50 MB, untested).  Everything
+    # else falls back to the composable ops (which still use the MXU
+    # merge when use_pallas is on).
     _tr = min(64, n)
     fused = (isinstance(comm, LocalComm) and comm.use_pallas
-             and n <= 2048 and n % _tr == 0 and _tr % 8 == 0)
+             and n <= 4096 and n % _tr == 0 and _tr % 8 == 0)
 
     def tick(state: WorldState, sched: Schedule):
         t = state.tick

@@ -127,15 +127,21 @@ def _masked_max_mxu(d_f32, v):
     ``W[s, j] = (v[s, j] == cur[j])``, and one boolean matmul
     ``d @ W > 0`` resolves every receiver whose delivery set contains a
     witness.  Unresolved (r, j) cells descend to the next distinct
-    value.  Real heartbeat/timestamp columns concentrate on a handful
-    of distinct values (everyone's view of a peer is within a few
-    ticks), so the ``while_loop`` typically runs 1-4 iterations — each
-    a 0/1 matmul (exact in bf16: products are 0/1 and row sums are
-    < 2^8 at N <= 512... accumulation is f32 on the MXU regardless)
-    plus O(N²) elementwise work — instead of the O(N³) VPU
-    product-max.  Worst case (adversarial value spread) degrades to
-    one iteration per distinct column value, which measures no worse
-    than the blockwise VPU reduction.
+    value.  Real heartbeat columns concentrate on a handful of
+    distinct values, so the ``while_loop`` typically runs 1-4
+    iterations — each a 0/1 matmul (exact: operands are 0/1 and
+    accumulation is f32 on the MXU) plus O(N²) elementwise work —
+    instead of the O(N³) VPU product-max.
+
+    Two in-vivo pathologies are cut off up front by a pre-resolve
+    matmul ``d @ (v > 0)``: receivers with NO contributing sender for
+    a column are done immediately (their max is the 0 FILL encoding)
+    instead of descending through every distinct stale value — after
+    a failure wave freezes half the columns, or when message drops
+    spread the fresh-timestamp columns over up to ``t_remove``
+    distinct per-tick values, the descent otherwise runs 10-20 levels
+    (measured ~16 ms/tick of witness matmuls at the dense N=4096 drop
+    config).
     """
     cur = v.max(0)
     # derive the carry initializers from the inputs (not plain
@@ -143,7 +149,10 @@ def _masked_max_mxu(d_f32, v):
     # varying-axis type as the loop body's outputs — same workaround
     # as gossip_reductions' scan init below
     m = (d_f32[:, :1] * 0).astype(v.dtype) + v[:1, :] * 0      # (R, J)
-    done = m > 0
+    has_any = lax.dot_general(d_f32, (v > 0).astype(jnp.float32),
+                              (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32) > 0
+    done = ~has_any
 
     def cond(c):
         m, cur, done = c
@@ -168,7 +177,7 @@ def _masked_max_mxu(d_f32, v):
 def gossip_reductions_mxu(recv_from, known, hb, ts, now, *,
                           t_remove: int, block_size: int = 128):
     """Same contract as :func:`gossip_reductions`, computed by MXU
-    level decomposition (:func:`_masked_max_mxu`) instead of the
+    level decomposition (:func:`_masked_max_mxu3`) instead of the
     blockwise VPU product-max.  Bit-identical outputs
     (tests/test_pallas.py::test_mxu_reductions_match); measured ~2x
     the end-to-end dense-tick throughput at N=512 on v5e.
@@ -176,6 +185,11 @@ def gossip_reductions_mxu(recv_from, known, hb, ts, now, *,
     """
     a1, f1, t1 = merge_payloads(known, hb, ts, now, t_remove)
     d = recv_from.astype(jnp.float32)
+    # separate per-plane loops: each plane runs only ITS OWN level
+    # count (sum-of-levels (S, J) matmuls beats max-of-levels (S, 3J)
+    # ones whenever the level counts are uneven, which is the in-vivo
+    # case — the timestamp plane needs ~3-6x the heartbeat planes'
+    # levels under drops)
     m_a = _masked_max_mxu(d, a1)
     m_f = _masked_max_mxu(d, f1)
     m_t = _masked_max_mxu(d, t1)

@@ -178,6 +178,11 @@ def fused_tick_update(m_all, m_fresh, t_fresh, recv_from,
     outs = pl.pallas_call(
         functools.partial(_kernel, t_remove, tr, n, with_events),
         grid=grid,
+        # ~17 double-buffered (TR, N) planes exceed the default 16 MB
+        # scoped window at N=4096 (the old n<=2048 envelope); v5e has
+        # 128 MB of physical VMEM
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=96 * 1024 * 1024),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),                # scalars
             row_tile, row_tile, row_tile,                         # maxima
