@@ -1,0 +1,50 @@
+"""Sparse device->host event staging (core/sim.py _pack_sparse /
+_masks_to_host): round-trip exactness, the dense-fallback overflow
+path, and degenerate shapes."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from gossip_protocol_tpu.core.sim import _masks_to_host
+
+
+def _roundtrip(added, removed, cap):
+    a, r = _masks_to_host(jnp.asarray(added), jnp.asarray(removed), cap)
+    assert np.array_equal(np.asarray(a), added)
+    assert np.array_equal(np.asarray(r), removed)
+
+
+def test_sparse_roundtrip_exact():
+    rng = np.random.default_rng(0)
+    c, n = 7, 100                      # n not a multiple of 32 (padding)
+    added = rng.random((c, n, n)) < 0.01
+    removed = rng.random((c, n, n)) < 0.002
+    _roundtrip(added, removed, cap=1 << 14)
+
+
+def test_sparse_dense_fallback_on_overflow():
+    """Masks denser than the word cap must fall back to the dense
+    transfer and still round-trip exactly."""
+    rng = np.random.default_rng(1)
+    c, n = 3, 64
+    added = rng.random((c, n, n)) < 0.9          # nearly every word set
+    removed = rng.random((c, n, n)) < 0.9
+    _roundtrip(added, removed, cap=8)            # cap << nonzero words
+
+
+def test_sparse_empty_and_full():
+    c, n = 2, 64
+    _roundtrip(np.zeros((c, n, n), bool), np.zeros((c, n, n), bool),
+               cap=1 << 10)
+    _roundtrip(np.ones((c, n, n), bool), np.ones((c, n, n), bool),
+               cap=2 * c * n * ((n + 31) // 32))  # exactly at the cap
+
+
+def test_sparse_degenerate_shapes():
+    # zero-length chunk and tiny n take the direct np.asarray path
+    a, r = _masks_to_host(jnp.zeros((0, 8, 8), bool),
+                          jnp.zeros((0, 8, 8), bool), cap=16)
+    assert a.shape == (0, 8, 8) and r.shape == (0, 8, 8)
+    one = jnp.ones((2, 1, 1), bool)
+    a, r = _masks_to_host(one, one, cap=16)
+    assert a.all() and r.all()
