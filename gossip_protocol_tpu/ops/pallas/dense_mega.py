@@ -20,8 +20,8 @@ tests/test_dense_mega.py runs the differential suite):
   level-descend ``lax.while_loop`` whose (N, N) state lives in VMEM
   scratch refs with a scalar-only carry (Mosaic cannot legalize
   vector-carried ``scf.while``) and whose witness resolution is one
-  f32 MXU matmul per level — exact, since operands are 0/1 and
-  accumulation is f32;
+  s8 x s8 -> s32 MXU matmul per level — exact (operands are 0/1,
+  accumulation is s32) at 2x the bf16 MXU rate, verified on-chip;
 * direct-sender increment / add (MP1Node.cpp:236-242), JOINREQ at the
   introducer (MP1Node.cpp:221-230), JOINREP at the joiner
   (MP1Node.cpp:231-233), TREMOVE staleness detection
@@ -98,10 +98,11 @@ def _kernel(n: int, s_ticks: int, t_remove: int, can_rejoin: bool,
     gossip_o[:] = gossip_in[:]
     aux_o[:] = aux_in[:]
 
-    def masked_max(d_f32, v):
+    def masked_max(d_i8, v):
         """m[r, j] = max over senders s with d[r, s] of v[s, j]
         (0 if none) — ops/merge.py _masked_max_mxu ported to scratch
-        refs + scalar-carried while (see module docstring)."""
+        refs + scalar-carried while (see module docstring).  Witness
+        matmuls run s8 x s8 -> s32 (2x the bf16 MXU rate, exact)."""
         m_scr[:] = jnp.zeros((n, n), i32)
         done_scr[:] = jnp.zeros((n, n), i32)
         cur_scr[0:1, :] = v.max(axis=0, keepdims=True)
@@ -111,10 +112,10 @@ def _kernel(n: int, s_ticks: int, t_remove: int, can_rejoin: bool,
 
         def body(go):
             cur = cur_scr[0:1, :]
-            w = ((v == cur) & (cur > 0)).astype(jnp.float32)
+            w = ((v == cur) & (cur > 0)).astype(jnp.int8)
             hit = jax.lax.dot_general(
-                d_f32, w, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32) > 0
+                d_i8, w, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32) > 0
             done = done_scr[:] > 0
             newly = hit & ~done
             m_scr[:] = jnp.where(newly, jnp.broadcast_to(cur, (n, n)),
@@ -195,10 +196,10 @@ def _kernel(n: int, s_ticks: int, t_remove: int, can_rejoin: bool,
         # ---- piggyback merge (ops/merge.py contract) ---------------
         k_i = known_b.astype(i32)
         fresh = k_i * (t - ts0 < t_remove)
-        d_f32 = recv_from.astype(jnp.float32)
-        m_a = masked_max(d_f32, k_i * (hb0 + 1)) - 1
-        m_f = masked_max(d_f32, fresh * (hb0 + 1)) - 1
-        m_t = masked_max(d_f32, fresh * (ts0 + 1)) - 1
+        d_i8 = recv_from.astype(jnp.int8)
+        m_a = masked_max(d_i8, k_i * (hb0 + 1)) - 1
+        m_f = masked_max(d_i8, fresh * (hb0 + 1)) - 1
+        m_t = masked_max(d_i8, fresh * (ts0 + 1)) - 1
         any_fresh = m_t >= 0
 
         exists = known_b
