@@ -1,0 +1,167 @@
+"""Static active-corner reduction for the dense full-view model.
+
+The reference introduces peer ``i`` at tick ``STEP_RATE * i``
+(Application.cpp:143) and its driver loops only over nodes that have
+been started (Application.cpp:138-163) — a 4096-peer run of 200 ticks
+touches ~800 nodes, and the C++ cost scales with the *started* count,
+not the configured one.  The batched tick (core/tick.py) as written
+pays the full (N, N) planes every tick regardless.
+
+Because start ticks are nondecreasing in the peer index, the set of
+peers that can ever act within a run is the contiguous prefix
+``[0, A)`` with ``A = min{i : start_tick(i) >= total_ticks}`` — a
+*static* bound derived from the config alone.  Peers outside it never
+start, never process, never send, and no entry for them is ever
+created (entries for ``j`` only arise from ``j``'s own messages), so
+every state row/column ``>= A`` is identically zero for the whole run
+(asserted by tests/test_dense_corner.py).  The run can therefore
+execute on the leading ``A x A`` corner of the planes and embed the
+result back — bit-identical, with the matmul work down by
+``(N / A)^3`` and the drop draw by ``(N / A)^2``.
+
+The drop stream is drawn at the corner width (``tick_drop_masks`` with
+``n = A``): mask bits outside the corner are dead (no send ever leaves
+it), and the full-width tick accepts ``n_active=A`` to consume the
+byte-identical stream for the differential tests.  Every *other* path
+(trace mode, sharded, dense mega at full width) draws at width N — so
+for a drop config with ``A < N`` the corner consumes a different,
+equally seeded realization of the same Bernoulli process.  For
+configs where every peer starts (``A == N`` — all grader testcases,
+the 512-peer bench family, every cross-path differential pairing in
+the suite) the streams coincide and all paths stay bit-identical.
+
+Bench mode only (``with_events=False``), and only for whole runs: the
+trace path materializes (T, N, N) event masks whose embedding would
+dominate, and ``Simulation.run`` compiles per-*chunk* runs whose
+``total_ticks`` is the chunk length — a chunk-derived bound would be
+wrong for later chunks' absolute ticks, so ``make_run`` never routes
+chunked runs here (``active_bound`` is meaningful only against the
+full horizon).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..config import SimConfig
+from ..state import Schedule, WorldState
+
+
+def active_bound(cfg: SimConfig) -> int:
+    """Smallest peer count that covers every peer that can ever act.
+
+    Two ways a peer enters the world: its scheduled start
+    (``start_tick(i) < total_ticks``; start ticks are monotone, so the
+    cutoff index is found by bisection) and — under the churn
+    extension — a scheduled *rejoin*, which re-runs nodeStart for the
+    victim regardless of its start tick (core/tick.py ``starting``).
+    Victims are drawn from the run seed, and the bound must stay
+    seed-independent (``make_run``/``Simulation`` cache one compiled
+    run per config and reseed it through the Schedule arrays alone),
+    so a config whose rejoin can fire inside the run gets no corner
+    at all.  The bound is padded up to a multiple of 128 so the
+    corner keeps the tile divisibility of the fused kernels, and
+    capped at N.
+    """
+    n, total = cfg.n, cfg.total_ticks
+    if (cfg.rejoin_after is not None
+            and cfg.fail_tick + cfg.rejoin_after < total):
+        return n
+    if total > 0 and cfg.start_tick(n - 1) < total:
+        return n
+    lo, hi = 0, n - 1          # invariant: start_tick(hi) >= total
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if cfg.start_tick(mid) >= total:
+            hi = mid
+        else:
+            lo = mid + 1
+    return min(n, -(-lo // 128) * 128)
+
+
+def _slice_state(state: WorldState, a: int) -> WorldState:
+    return WorldState(
+        tick=state.tick, rng=state.rng,
+        in_group=state.in_group[:a], own_hb=state.own_hb[:a],
+        known=state.known[:a, :a], hb=state.hb[:a, :a],
+        ts=state.ts[:a, :a], gossip=state.gossip[:a, :a],
+        joinreq=state.joinreq[:a], joinrep=state.joinrep[:a])
+
+
+def _embed_state(state_a: WorldState, n: int) -> WorldState:
+    a = state_a.known.shape[0]
+
+    def vec(v):
+        return jnp.zeros((n,), v.dtype).at[:a].set(v)
+
+    def plane(p):
+        return jnp.zeros((n, n), p.dtype).at[:a, :a].set(p)
+
+    return WorldState(
+        tick=state_a.tick, rng=state_a.rng,
+        in_group=vec(state_a.in_group), own_hb=vec(state_a.own_hb),
+        known=plane(state_a.known), hb=plane(state_a.hb),
+        ts=plane(state_a.ts), gossip=plane(state_a.gossip),
+        joinreq=vec(state_a.joinreq), joinrep=vec(state_a.joinrep))
+
+
+def make_corner_run(cfg: SimConfig, a: int, block_size: int = 128,
+                    use_pallas: bool | None = None):
+    """Bench-mode whole-run function on the ``a x a`` active corner.
+
+    Same contract as ``make_run(cfg, with_events=False)``: a
+    ``run(state, sched) -> (final_state, TickEvents)`` over full-width
+    arrays; internally the scan runs at width ``a``.  When the corner
+    fits the dense megakernel envelope the launches ride it (the
+    BASELINE N=4096 / 200-tick shape has A = 896; a corner of <= 512
+    arises for longer-N, shorter-T points).
+    """
+    from ..parallel.comm import LocalComm
+    from .dense_mega import dense_mega_supported, make_dense_mega_run
+    from .tick import TickEvents, make_tick
+
+    n = cfg.n
+    assert 0 < a < n and a % 8 == 0
+    cfg_a = cfg.replace(max_nnb=a)
+    comm = LocalComm(use_pallas)
+    mega = (comm.use_pallas and dense_mega_supported(cfg_a)
+            and jax.default_backend() == "tpu")
+    if mega:
+        inner = make_dense_mega_run(cfg_a, with_events=False, as_body=True)
+    else:
+        tick = make_tick(cfg_a, block_size, use_pallas=comm.use_pallas,
+                         with_events=False)
+
+        def inner(state_a, sched_a):
+            def step(carry, _):
+                carry, ev = tick(carry, sched_a)
+                return carry, (ev.sent, ev.recv)
+            final_a, (sent, recv) = jax.lax.scan(
+                step, state_a, None, length=cfg.total_ticks)
+            # bench-mode event placeholders are (T,)-shaped on every
+            # make_run path (scan-stacked scalars / mega's zeros)
+            ev = TickEvents(added=jnp.zeros((cfg.total_ticks,), bool),
+                            removed=jnp.zeros((cfg.total_ticks,), bool),
+                            sent=sent, recv=recv)
+            return final_a, ev
+
+    def run_body(state: WorldState, sched: Schedule):
+        sched_a = Schedule(
+            start_tick=sched.start_tick[:a], fail_tick=sched.fail_tick[:a],
+            rejoin_tick=sched.rejoin_tick[:a],
+            drop_active=sched.drop_active, drop_prob=sched.drop_prob)
+        final_a, ev = inner(_slice_state(state, a), sched_a)
+        pad = ((0, 0), (0, n - a))
+        ev = TickEvents(added=ev.added, removed=ev.removed,
+                        sent=jnp.pad(ev.sent, pad),
+                        recv=jnp.pad(ev.recv, pad))
+        return _embed_state(final_a, n), ev
+
+    if jax.default_backend() == "tpu":
+        # same raised scoped-VMEM window as make_dense_mega_run: the
+        # megakernel (and the fused epilogue at larger corners) runs
+        # inlined under this jit
+        return jax.jit(run_body, compiler_options={
+            "xla_tpu_scoped_vmem_limit_kib": "114688"})
+    return jax.jit(run_body)

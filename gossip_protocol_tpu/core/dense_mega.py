@@ -32,13 +32,17 @@ def dense_mega_supported(cfg: SimConfig) -> bool:
     return 16 <= cfg.n <= DENSE_MEGA_N_LIMIT and cfg.n % 8 == 0
 
 
-def make_dense_mega_run(cfg: SimConfig, with_events: bool = False):
+def make_dense_mega_run(cfg: SimConfig, with_events: bool = False,
+                        as_body: bool = False):
     """``run(state, sched) -> (final, TickEvents)`` over the whole run.
 
     ``with_events=False`` is bench mode (sent/recv counters only);
     ``with_events=True`` also returns the full (T, N, N) added/removed
     masks, emitted per tick by the kernel itself — the graded
-    trace-mode path rides the same megakernel."""
+    trace-mode path rides the same megakernel.  ``as_body`` returns
+    the unjitted TPU body for inlining under a caller's jit (the
+    corner run, core/dense_corner.py) — TPU only, the caller must
+    raise the scoped-VMEM window itself."""
     from .tick import TickEvents
     assert dense_mega_supported(cfg)
     n = cfg.n
@@ -131,6 +135,9 @@ def make_dense_mega_run(cfg: SimConfig, with_events: bool = False):
         return assemble(planes, aux, t, state.rng, sents, recvs,
                         addeds, removeds)
 
+    if as_body:
+        assert jax.default_backend() == "tpu"
+        return run_body
     if jax.default_backend() == "tpu":
         return jax.jit(run_body, compiler_options={
             "xla_tpu_scoped_vmem_limit_kib": "114688"})

@@ -73,7 +73,8 @@ class TickEvents:
 
 
 def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
-              use_pallas: bool | None = None, with_events: bool = True):
+              use_pallas: bool | None = None, with_events: bool = True,
+              n_active: int | None = None):
     """Build the tick function for a config (shapes are static).
 
     Returned signature: ``tick(state, sched) -> (state', TickEvents)``.
@@ -85,9 +86,19 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
     membership update, detection, and dissemination in one launch —
     while the sharded ring path uses the composable merge kernel.
     ``use_pallas`` is ignored when an explicit ``comm`` is passed.
+
+    ``n_active`` pins the drop-stream width: the Bernoulli lattice is
+    drawn at ``n_active`` peers and embedded into the (N, N) masks
+    (zeros outside — no send ever leaves the active corner, see
+    core/dense_corner.py).  The corner-reduced run draws at its own
+    width natively; passing the same ``n_active`` here makes the
+    full-width path consume the byte-identical stream, which is what
+    the corner differential tests rely on.  Default: N.
     """
     comm = comm or LocalComm(use_pallas)
     n = cfg.n
+    na = n if n_active is None else n_active
+    assert na <= n
     t_remove = cfg.t_remove
     churn = cfg.rejoin_after is not None
     assert n % comm.n_shards == 0, "peer count must divide the mesh axis"
@@ -166,7 +177,13 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
 
         # ENsend drop injection (EmulNet.cpp:90-94)
         gdrop_all, qdrop, pdrop = tick_drop_masks(
-            state.rng, t, n, sched.drop_active[t], sched.drop_prob)
+            state.rng, t, na, sched.drop_active[t], sched.drop_prob)
+        if na < n:
+            # embed the active-corner stream; pairs outside the corner
+            # never carry a send, so their mask bits are dead
+            gdrop_all = jnp.zeros((n, n), bool).at[:na, :na].set(gdrop_all)
+            qdrop = jnp.zeros((n,), bool).at[:na].set(qdrop)
+            pdrop = jnp.zeros((n,), bool).at[:na].set(pdrop)
         gdrop = comm.slice_rows(gdrop_all)               # local sender rows
         joinreq_sent = joinreq_new & ~qdrop
         rep_out = jreq
@@ -334,12 +351,29 @@ def make_run(cfg: SimConfig, block_size: int = 128, with_events: bool = True,
     (benchmark mode — avoids materializing T*(N,N) masks).
     """
     comm = LocalComm(use_pallas)
+    from .dense_corner import active_bound, make_corner_run
     from .dense_mega import dense_mega_supported, make_dense_mega_run
     mega = comm.use_pallas and dense_mega_supported(cfg)
+    a = active_bound(cfg)
+    corner = (not with_events) and not mega and 0 < a < cfg.n
     key = (cfg.n, cfg.t_remove, cfg.total_ticks, block_size, with_events,
-           comm.use_pallas, mega, cfg.rejoin_after is not None)
+           comm.use_pallas, mega, cfg.rejoin_after is not None,
+           a if corner else cfg.n)
     if key in _RUN_CACHE:
         return _RUN_CACHE[key]
+    if corner:
+        # bench mode at a config whose schedule never starts peers
+        # >= A: run on the static active corner (dense_corner.py) —
+        # (N/A)^3 less matmul work.  Bit-identical to a full-width run
+        # consuming the same width-A drop stream (tests pin this via
+        # make_tick(n_active=A)); the full-width paths below draw at
+        # width N, so for a drop config with A < N the corner is a
+        # different — equally seeded — realization of the same
+        # Bernoulli process.  See dense_corner.py for why the corner
+        # cannot be chunked: A is derived from the whole-run horizon.
+        run = make_corner_run(cfg, a, block_size, use_pallas=use_pallas)
+        _RUN_CACHE[key] = run
+        return run
     if mega:
         # TPU: DENSE_MEGA_TICKS whole ticks per Pallas launch, state
         # resident in VMEM — bit-identical to the per-tick path
@@ -349,6 +383,12 @@ def make_run(cfg: SimConfig, block_size: int = 128, with_events: bool = True,
         run = make_dense_mega_run(cfg, with_events=with_events)
         _RUN_CACHE[key] = run
         return run
+    # NOTE: this path deliberately draws the drop stream at full width
+    # even when active_bound < N — Simulation.run() compiles it for
+    # chunk lengths (cfg.total_ticks is a CHUNK here, not the run
+    # horizon), so a chunk-derived active bound would be wrong for
+    # later chunks' absolute ticks.  Width-A streams belong to the
+    # corner path alone, which always spans the whole run.
     tick = make_tick(cfg, block_size, comm=comm, with_events=with_events)
 
     @jax.jit

@@ -1,0 +1,136 @@
+"""Component timing of the dense full-view tick at large N (dev tool).
+
+Decomposes the per-tick wall time of the BASELINE "N=4096, 10% drop"
+dense config into: whole tick, drop-mask draw, MXU merge, fused
+epilogue — all timed as whole-``lax.scan`` runs on the live backend
+(single dispatches through this image's TPU relay cost ~100 ms; see
+.claude/skills/verify/SKILL.md).  The residual is the XLA glue the
+next dense kernel iteration must fuse.
+
+Usage: python scripts/dense4k_probe.py [N] [ticks-to-steady-state]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from gossip_protocol_tpu.config import SimConfig
+from gossip_protocol_tpu.core.tick import make_tick
+from gossip_protocol_tpu.ops.drop import tick_drop_masks
+from gossip_protocol_tpu.ops.merge import gossip_reductions_mxu
+from gossip_protocol_tpu.ops.pallas.tickfused import fused_tick_update
+from gossip_protocol_tpu.state import init_state, make_schedule
+
+
+def timed(fn, variants, reps=3):
+    """Best wall time of fn over distinct inputs with a readback."""
+    out = jax.block_until_ready(fn(variants[0]))        # compile
+    np.asarray(jax.tree_util.tree_leaves(out)[0])
+    best = float("inf")
+    for i in range(reps):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(variants[i + 1]))
+        np.asarray(jax.tree_util.tree_leaves(out)[0])
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    warm = int(sys.argv[2]) if len(sys.argv) > 2 else 120
+    length = 32
+    reps = 3
+
+    cfg = SimConfig(max_nnb=n, single_failure=False, drop_msg=True,
+                    msg_drop_prob=0.1, seed=0, total_ticks=200)
+    sched = make_schedule(cfg)
+    state0 = init_state(cfg)
+    tick = make_tick(cfg, with_events=False)
+
+    @jax.jit
+    def advance(s):
+        def step(c, _):
+            return tick(c, sched)[0], None
+        return jax.lax.scan(step, s, None, length=warm)[0]
+
+    print(f"backend={jax.default_backend()} n={n}", flush=True)
+    state = jax.block_until_ready(advance(state0))
+    print(f"steady state at t={int(state.tick)} "
+          f"known_rows={int(state.known.sum(1).max())}", flush=True)
+
+    # ---- whole tick ------------------------------------------------
+    @jax.jit
+    def full(s):
+        def step(c, _):
+            return tick(c, sched)[0], None
+        return jax.lax.scan(step, s, None, length=length)[0]
+
+    variants = [state.replace(own_hb=state.own_hb + i)
+                for i in range(reps + 1)]
+    t_full = timed(lambda s: full(s).hb, variants, reps) / length
+    print(f"full tick          {t_full * 1e3:8.3f} ms", flush=True)
+
+    # ---- drop draw -------------------------------------------------
+    @jax.jit
+    def drops(s):
+        def step(c, i):
+            g, q, p = tick_drop_masks(s.rng, s.tick + i, n,
+                                      jnp.asarray(True), sched.drop_prob)
+            return c ^ g[0, 0] ^ q[0] ^ p[0], None
+        return jax.lax.scan(step, jnp.asarray(False),
+                            jnp.arange(length))[0]
+
+    t_drop = timed(drops, variants, reps) / length
+    print(f"drop-mask draw     {t_drop * 1e3:8.3f} ms", flush=True)
+
+    # ---- MXU merge -------------------------------------------------
+    deliver = state.gossip
+    recv_from = jnp.transpose(deliver)
+
+    @jax.jit
+    def merge(s):
+        def step(c, i):
+            m_a, m_f, m_t, anyf = gossip_reductions_mxu(
+                recv_from, s.known, s.hb + c, s.ts, s.tick + i,
+                t_remove=cfg.t_remove)
+            return c + (m_a[0, 0] & 1), None
+        return jax.lax.scan(step, jnp.int32(0), jnp.arange(length))[0]
+
+    t_merge = timed(merge, variants, reps) / length
+    print(f"mxu merge          {t_merge * 1e3:8.3f} ms", flush=True)
+
+    # ---- fused epilogue -------------------------------------------
+    m_a, m_f, m_t, _ = jax.jit(
+        lambda s: gossip_reductions_mxu(recv_from, s.known, s.hb, s.ts,
+                                        s.tick, t_remove=cfg.t_remove)
+    )(state)
+    g0, q0, p0 = tick_drop_masks(state.rng, state.tick, n,
+                                 jnp.asarray(True), sched.drop_prob)
+    ops = jnp.ones((n,), bool)
+    zeros = jnp.zeros((n,), bool)
+
+    @jax.jit
+    def epi(s):
+        def step(c, i):
+            out = fused_tick_update(
+                m_a, m_f, m_t, recv_from, s.known, s.hb + c, s.ts,
+                s.gossip, g0, ops, zeros, zeros, zeros, s.tick + i,
+                t_remove=cfg.t_remove, with_events=False)
+            return c + (out[1][0, 0] & 1), None
+        return jax.lax.scan(step, jnp.int32(0), jnp.arange(length))[0]
+
+    t_epi = timed(epi, variants, reps) / length
+    print(f"fused epilogue     {t_epi * 1e3:8.3f} ms", flush=True)
+
+    resid = t_full - t_drop - t_merge - t_epi
+    print(f"residual glue      {resid * 1e3:8.3f} ms", flush=True)
+    print(f"ticks/s (full)     {1.0 / t_full:8.1f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,165 @@
+"""Differential tests: active-corner dense run vs the full-width path.
+
+The corner reduction (core/dense_corner.py) must replay the full
+(N, N) path's exact trajectory whenever both consume the same drop
+stream — and the invariant it rests on (no state ever appears outside
+the active prefix) must hold on the full-width path with its native
+stream too.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gossip_protocol_tpu.config import SimConfig
+from gossip_protocol_tpu.core.dense_corner import (active_bound,
+                                                   make_corner_run)
+from gossip_protocol_tpu.core.tick import make_run, make_tick
+from gossip_protocol_tpu.state import init_state, make_schedule
+
+STATE_FIELDS = ("tick", "in_group", "own_hb", "known", "hb", "ts",
+                "gossip", "joinreq", "joinrep")
+
+
+def _cfg(drop: bool, n=256, total=30, **kw):
+    kw.setdefault("fail_tick", 20)
+    kw.setdefault("single_failure", False)
+    kw.setdefault("seed", 11)
+    if drop:
+        kw.update(drop_msg=True, msg_drop_prob=0.25,
+                  drop_open_tick=5, drop_close_tick=25)
+    else:
+        kw.setdefault("drop_msg", False)
+    return SimConfig(max_nnb=n, total_ticks=total, **kw)
+
+
+def _full_run(cfg, n_active=None):
+    tick = make_tick(cfg, use_pallas=False, with_events=False,
+                     n_active=n_active)
+
+    @jax.jit
+    def run(state, sched):
+        def step(c, _):
+            c, ev = tick(c, sched)
+            return c, (ev.sent, ev.recv)
+        return jax.lax.scan(step, state, None, length=cfg.total_ticks)
+
+    return run
+
+
+def _assert_same(fa, ea, fb, eb):
+    for name in STATE_FIELDS:
+        x, y = np.asarray(getattr(fa, name)), np.asarray(getattr(fb, name))
+        assert np.array_equal(x, y), f"state field {name} diverged"
+    np.testing.assert_array_equal(np.asarray(ea[0]), np.asarray(eb.sent))
+    np.testing.assert_array_equal(np.asarray(ea[1]), np.asarray(eb.recv))
+
+
+def test_active_bound_matches_bruteforce():
+    cfgs = [SimConfig(max_nnb=n, total_ticks=t)
+            for n, t in [(256, 30), (256, 1000), (64, 5), (512, 127),
+                         (4096, 200)]]
+    cfgs += [SimConfig(max_nnb=256, total_ticks=30, rejoin_after=8,
+                       fail_tick=12, single_failure=sf, seed=s)
+             for sf in (True, False) for s in (0, 3, 11)]
+    for cfg in cfgs:
+        a = active_bound(cfg)
+        sched = make_schedule(cfg)
+        start = np.asarray(sched.start_tick)
+        rejoin = np.asarray(sched.rejoin_tick)
+        active = (start < cfg.total_ticks) | (rejoin < cfg.total_ticks)
+        a_raw = int(np.flatnonzero(active).max()) + 1 if active.any() else 0
+        assert a_raw <= a <= cfg.n
+        if a < cfg.n:
+            assert a % 128 == 0 and a - a_raw < 128
+
+
+def test_corner_matches_full_without_drops():
+    cfg = _cfg(drop=False)
+    a = active_bound(cfg)
+    assert a < cfg.n
+    sched, state = make_schedule(cfg), init_state(cfg)
+    fa, ea = _full_run(cfg)(state, sched)
+    fb, eb = make_corner_run(cfg, a, use_pallas=False)(state, sched)
+    _assert_same(fa, ea, fb, eb)
+
+
+def test_corner_matches_full_same_drop_stream():
+    cfg = _cfg(drop=True)
+    a = active_bound(cfg)
+    assert a < cfg.n
+    sched, state = make_schedule(cfg), init_state(cfg)
+    fa, ea = _full_run(cfg, n_active=a)(state, sched)
+    fb, eb = make_corner_run(cfg, a, use_pallas=False)(state, sched)
+    _assert_same(fa, ea, fb, eb)
+
+
+def test_make_run_picks_corner_and_matches():
+    cfg = _cfg(drop=True, total=25)
+    a = active_bound(cfg)
+    assert a < cfg.n
+    sched, state = make_schedule(cfg), init_state(cfg)
+    run = make_run(cfg, with_events=False, use_pallas=False)
+    fb, eb = run(state, sched)
+    fa, ea = _full_run(cfg, n_active=a)(state, sched)
+    _assert_same(fa, ea, fb, eb)
+    assert int(fb.tick) == cfg.total_ticks
+
+
+def test_nothing_exists_outside_corner_on_full_path():
+    # full-width path with its native stream: the invariant the corner
+    # rests on must hold regardless of which stream is drawn
+    cfg = _cfg(drop=True)
+    a = active_bound(cfg)
+    sched, state = make_schedule(cfg), init_state(cfg)
+    fa, _ = _full_run(cfg)(state, sched)
+    for name in ("known", "hb", "ts", "gossip"):
+        p = np.asarray(getattr(fa, name))
+        assert not p[a:, :].any(), f"{name} rows >= A nonzero"
+        assert not p[:, a:].any(), f"{name} cols >= A nonzero"
+    for name in ("in_group", "own_hb", "joinreq", "joinrep"):
+        v = np.asarray(getattr(fa, name))
+        assert not v[a:].any(), f"{name} >= A nonzero"
+
+
+def test_churn_gets_no_corner():
+    # victims are seed-drawn and the compiled run must stay reusable
+    # across reseeds (core/sim.py caches it), so a config whose rejoin
+    # can fire inside the run must report the full width
+    cfg = _cfg(drop=False, rejoin_after=8, single_failure=True,
+               fail_tick=12)
+    assert active_bound(cfg) == cfg.n
+    # ... but with the rejoin outside the run the start bound applies
+    assert active_bound(cfg.replace(rejoin_after=1000)) < cfg.n
+
+
+def test_corner_run_handles_churn_when_victim_covered():
+    # make_corner_run itself is churn-correct whenever the caller's
+    # bound covers the victim — exercised here with a bound derived
+    # from the realized schedule
+    cfg = sched = a = None
+    for seed in range(64):
+        c = _cfg(drop=False, rejoin_after=8, single_failure=True,
+                 fail_tick=12, seed=seed)
+        s = make_schedule(c)
+        start = np.asarray(s.start_tick)
+        rejoin = np.asarray(s.rejoin_tick)
+        active = (start < c.total_ticks) | (rejoin < c.total_ticks)
+        a_raw = int(np.flatnonzero(active).max()) + 1
+        pad = min(c.n, -(-a_raw // 128) * 128)
+        if pad < c.n:
+            cfg, sched, a = c, s, pad
+            break
+    assert cfg is not None, "no seed with an in-corner victim found"
+    state = init_state(cfg)
+    fa, ea = _full_run(cfg)(state, sched)
+    fb, eb = make_corner_run(cfg, a, use_pallas=False)(state, sched)
+    _assert_same(fa, ea, fb, eb)
+
+
+def test_zero_tick_bound_is_zero():
+    # a == 0 must not be treated as a corner (make_run guards 0 < a);
+    # the zero-length run itself goes down the pre-existing full path
+    cfg = _cfg(drop=False, total=0)
+    assert active_bound(cfg) == 0
