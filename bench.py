@@ -115,24 +115,33 @@ def _roofline(cfg, ticks_per_s: float, backend: str) -> dict:
             out["path"] = "fused"
             out["bound"] = "hbm + per-launch dispatch"
     else:
+        from gossip_protocol_tpu.core.dense_corner import active_bound
         from gossip_protocol_tpu.core.dense_mega import dense_mega_supported
-        cell = n * n
-        flops_per_tick = 3 * 3 * 2 * n ** 3       # 3 reductions x ~3 levels
-        if dense_mega_supported(cfg) and backend == "tpu":
-            # bench mode runs the dense megakernel (core/tick.py): the
-            # four (N, N) planes live in VMEM across a 16-tick launch,
-            # HBM sees planes in + out once per launch plus the
-            # precomputed (S, N, N) drop stack read once
-            from gossip_protocol_tpu.ops.pallas.dense_mega import \
-                DENSE_MEGA_TICKS
-            bytes_per_tick = cell * 4 * (4 * 2 / DENSE_MEGA_TICKS + 1)
-            out["path"] = "dense-mega"
+        from gossip_protocol_tpu.ops.pallas.dense_mega import \
+            dense_mega_ticks_for
+        # bench mode runs on the static active corner when the
+        # schedule never starts peers >= A (core/dense_corner.py) —
+        # the roofline must describe the width that actually executes
+        a = active_bound(cfg)
+        n_eff = a if 0 < a < n else n
+        cell = n_eff * n_eff
+        flops_per_tick = 3 * 3 * 2 * n_eff ** 3   # 3 reductions x ~3 levels
+        corner_tag = "corner-" if n_eff < n else ""
+        if dense_mega_supported(cfg.replace(max_nnb=n_eff)) \
+                and backend == "tpu":
+            # dense megakernel: the four (N, N) planes live in VMEM
+            # across an S-tick launch, HBM sees planes in + out once
+            # per launch plus the precomputed (S, N, N) drop stack
+            # read once
+            s = dense_mega_ticks_for(n_eff)
+            bytes_per_tick = cell * 4 * (4 * 2 / s + 1)
+            out["path"] = corner_tag + "dense-mega"
             out["bound"] = "in-kernel mxu merge + vpu sequencing"
         else:
             # hb/ts i32 + known/gossip i8, read+write once (XLA fuses
             # the elementwise chain); recv mask read
             bytes_per_tick = cell * (4 + 4 + 1 + 1) * 2 + cell
-            out["path"] = "dense"
+            out["path"] = corner_tag + "dense"
             out["bound"] = "mxu merge + per-tick dispatch"
         out["mxu_util"] = round(flops_per_tick * ticks_per_s
                                 / V5E_MXU_FLOPS, 4)

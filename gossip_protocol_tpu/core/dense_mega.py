@@ -22,14 +22,19 @@ import jax.numpy as jnp
 
 from ..config import SimConfig
 from ..ops.drop import tick_drop_masks
-from ..ops.pallas.dense_mega import (DENSE_MEGA_N_LIMIT, DENSE_MEGA_TICKS,
-                                     dense_mega_ticks)
+from ..ops.pallas.dense_mega import (DENSE_MEGA_N_LIMIT,
+                                     DENSE_MEGA_N_LIMIT_BENCH,
+                                     dense_mega_ticks,
+                                     dense_mega_ticks_for)
 from ..state import Schedule, WorldState
 
 
-def dense_mega_supported(cfg: SimConfig) -> bool:
-    """Bench-mode dense megakernel envelope (single device)."""
-    return 16 <= cfg.n <= DENSE_MEGA_N_LIMIT and cfg.n % 8 == 0
+def dense_mega_supported(cfg: SimConfig, with_events: bool = False) -> bool:
+    """Dense megakernel envelope (single device).  Trace mode carries
+    two extra (S, N, N) event planes in VMEM, so its envelope is
+    smaller than bench mode's."""
+    limit = DENSE_MEGA_N_LIMIT if with_events else DENSE_MEGA_N_LIMIT_BENCH
+    return 16 <= cfg.n <= limit and cfg.n % 8 == 0
 
 
 def make_dense_mega_run(cfg: SimConfig, with_events: bool = False,
@@ -44,10 +49,10 @@ def make_dense_mega_run(cfg: SimConfig, with_events: bool = False,
     corner run, core/dense_corner.py) — TPU only, the caller must
     raise the scoped-VMEM window itself."""
     from .tick import TickEvents
-    assert dense_mega_supported(cfg)
+    assert dense_mega_supported(cfg, with_events)
     n = cfg.n
     total = cfg.total_ticks
-    s_full = DENSE_MEGA_TICKS
+    s_full = dense_mega_ticks_for(n)
     n_chunks, rem = divmod(total, s_full)
     can_rejoin = cfg.rejoin_after is not None
     kern_kw = dict(n=n, t_remove=cfg.t_remove, can_rejoin=can_rejoin,

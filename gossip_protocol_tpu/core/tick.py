@@ -353,9 +353,12 @@ def make_run(cfg: SimConfig, block_size: int = 128, with_events: bool = True,
     comm = LocalComm(use_pallas)
     from .dense_corner import active_bound, make_corner_run
     from .dense_mega import dense_mega_supported, make_dense_mega_run
-    mega = comm.use_pallas and dense_mega_supported(cfg)
+    mega = comm.use_pallas and dense_mega_supported(cfg, with_events)
     a = active_bound(cfg)
-    corner = (not with_events) and not mega and 0 < a < cfg.n
+    # corner precedence over full-width mega is deliberate: the corner
+    # saves (N/A)^3 of the work and rides the megakernel internally
+    # whenever the corner width fits its envelope
+    corner = (not with_events) and 0 < a < cfg.n
     key = (cfg.n, cfg.t_remove, cfg.total_ticks, block_size, with_events,
            comm.use_pallas, mega, cfg.rejoin_after is not None,
            a if corner else cfg.n)

@@ -50,12 +50,23 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-#: dense ticks per launch
+#: dense ticks per launch (halved above 512 peers: the (S, N, N) drop
+#: stack and the ~12 live (N, N) temporaries share the same VMEM)
 DENSE_MEGA_TICKS = 16
 
 #: VMEM bound: ~(8 + S/4 + ~12 temporaries) (N, N) i32-equivalent
-#: planes must fit under the raised scoped window
+#: planes must fit under the raised scoped window.  Bench mode (no
+#: event outputs) is hardware-validated up to 1024 (the active-corner
+#: width of the BASELINE N=4096 dense config is 896); trace mode adds
+#: two (S, N, N) event planes and keeps the 512 envelope.
 DENSE_MEGA_N_LIMIT = 512
+DENSE_MEGA_N_LIMIT_BENCH = 1024
+
+
+def dense_mega_ticks_for(n: int) -> int:
+    """Ticks per launch for a peer count (VMEM-bounded)."""
+    return DENSE_MEGA_TICKS if n <= DENSE_MEGA_N_LIMIT \
+        else DENSE_MEGA_TICKS // 2
 
 #: aux lane offsets
 _IN_GROUP = 0

@@ -96,6 +96,43 @@ def test_dense_megakernel_events_equal_xla(scenario):
 def test_dense_mega_envelope():
     assert dense_mega_supported(_cfg("single", 64))
     assert dense_mega_supported(_cfg("single", 512))
-    assert not dense_mega_supported(
-        SimConfig(max_nnb=1024, single_failure=True, drop_msg=False,
-                  total_ticks=50))
+    big = SimConfig(max_nnb=1024, single_failure=True, drop_msg=False,
+                    total_ticks=50)
+    # bench mode reaches 1024 (the 4096-config active corner is 896);
+    # trace mode's two extra (S, N, N) event planes keep it at 512
+    assert dense_mega_supported(big)
+    assert not dense_mega_supported(big, with_events=True)
+    assert not dense_mega_supported(big.replace(max_nnb=2048))
+
+
+def test_dense_mega_reduced_ticks_above_512():
+    """The S=8 launch shape (N > 512) replays the per-tick path too."""
+    import jax
+
+    from gossip_protocol_tpu.core.tick import make_tick
+    cfg = SimConfig(max_nnb=576, single_failure=True, drop_msg=True,
+                    msg_drop_prob=0.2, seed=13, total_ticks=44,
+                    fail_tick=20, drop_open_tick=8, drop_close_tick=36)
+    sched = make_schedule(cfg)
+    state = init_state(cfg)
+    # full-width per-tick scan, NOT make_run: this config's active
+    # bound (256) would route make_run to the corner path, whose drop
+    # stream is drawn at width A while the megakernel draws at N
+    tick = make_tick(cfg, use_pallas=False, with_events=False)
+
+    @jax.jit
+    def run_x(s, sc):
+        def step(c, _):
+            c, ev = tick(c, sc)
+            return c, (ev.sent, ev.recv)
+        return jax.lax.scan(step, s, None, length=cfg.total_ticks)
+
+    fx, (sent_x, recv_x) = run_x(state, sched)
+    ex = type("E", (), {"sent": sent_x, "recv": recv_x})
+    fm, em = make_dense_mega_run(cfg)(state, sched)
+    for name in STATE_FIELDS:
+        a, b = np.asarray(getattr(fx, name)), np.asarray(getattr(fm, name))
+        assert np.array_equal(a, b), f"state field {name} diverged"
+    for name in ("sent", "recv"):
+        a, b = np.asarray(getattr(ex, name)), np.asarray(getattr(em, name))
+        assert np.array_equal(a, b)
