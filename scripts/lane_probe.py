@@ -1,0 +1,239 @@
+"""Probe: do 64-lane (B, K) vector ops waste half of every vreg, and
+can two exchange rounds share full-width vregs via lane-concat?
+
+The grid kernel's per-round merge chain operates on (B, K=64) i32
+operands.  If Mosaic pads the minor dim to the native 128-lane tile,
+each such op costs the same vregs as a (B, 128) op — and packing TWO
+rounds side by side into (B, 128) would halve the merge-phase op
+count, IF the lane-concat of two 64-lane halves is cheap and accepted
+(a direct vector bitcast repack was rejected by this Mosaic:
+"Invalid vector register cast", docs/PERF.md §3).
+
+Three timed kernels, each running ITERS repetitions of an F-round
+merge-like chain (~20 ops/round of the grid kernel's op mix) inside
+one launch:
+  narrow — per round, ops on (B, 64) operands (the grid kernel today)
+  wide   — same op count on (B, 128) operands (cost ceiling check)
+  packed — rounds in pairs: concat halves to (B, 128), one chain per
+           pair, fold the two halves at the end with a lane roll
+
+Usage: python scripts/lane_probe.py [B] [F] [ITERS]
+"""
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, ".")
+
+K = 64
+
+
+def _chain(x, y, t):
+    """~20-op merge-like chain (compares, selects, shifts, a cheap
+    hash) on same-shape i32 operands."""
+    xu = x.astype(jnp.uint32)
+    yu = y.astype(jnp.uint32)
+    h = (xu ^ (yu >> 7)) * jnp.uint32(0x9E3779B9)
+    h = h ^ (h >> 15)
+    valid = (x >= 0) & (y > t) & (x != y)
+    key = jnp.where(valid, (yu << 12) | (xu & 0xFFF), jnp.uint32(0))
+    pay = jnp.where(valid, y + 1, 0)
+    better = key > (h & jnp.uint32(0x00FFFFFF))
+    k2 = jnp.where(better, key, h)
+    p2 = jnp.where(better, pay, x)
+    stale = (k2 < (jnp.uint32(5) << 12)) & (p2 > 0)
+    return jnp.where(stale, 0, k2).astype(jnp.int32), \
+        jnp.where(stale, -1, p2)
+
+
+def _kernel(mode: str, f: int, iters: int, x_ref, o_ref, acc_ref):
+    b = x_ref.shape[0]
+
+    def body(s, _):
+        t = s & 7
+        if mode == "narrow":
+            ka = x_ref[:, 0:K]
+            pa = x_ref[:, K:2 * K]
+            for fi in range(f):
+                xin = x_ref[:, 0:K] + (s + fi)
+                yin = x_ref[:, K:2 * K] ^ fi
+                k1, p1 = _chain(xin, yin, t)
+                sel = k1 > ka
+                ka = jnp.where(sel, k1, ka)
+                pa = jnp.where(sel, p1, pa)
+            acc_ref[:, 0:K] = ka
+            acc_ref[:, K:2 * K] = pa
+        elif mode == "wide":
+            ka = x_ref[:]
+            pa = x_ref[:]
+            for fi in range(f):
+                xin = x_ref[:] + (s + fi)
+                yin = x_ref[:] ^ fi
+                k1, p1 = _chain(xin, yin, t)
+                sel = k1 > ka
+                ka = jnp.where(sel, k1, ka)
+                pa = jnp.where(sel, p1, pa)
+            acc_ref[:] = ka + pa
+        else:                                  # packed
+            ka = x_ref[:, 0:K]
+            pa = x_ref[:, K:2 * K]
+            for fi in range(0, f, 2):
+                xin = jnp.concatenate(
+                    [x_ref[:, 0:K] + (s + fi), x_ref[:, 0:K] + (s + fi + 1)],
+                    axis=1)
+                yin = jnp.concatenate(
+                    [x_ref[:, K:2 * K] ^ fi, x_ref[:, K:2 * K] ^ (fi + 1)],
+                    axis=1)
+                k1, p1 = _chain(xin, yin, t)
+                # fold the two 64-lane halves: lane-roll by K then lex
+                k1r = jnp.concatenate([k1[:, K:], k1[:, :K]], axis=1)
+                p1r = jnp.concatenate([p1[:, K:], p1[:, :K]], axis=1)
+                sel2 = k1r > k1
+                kf = jnp.where(sel2, k1r, k1)[:, 0:K]
+                pf = jnp.where(sel2, p1r, p1)[:, 0:K]
+                sel = kf > ka
+                ka = jnp.where(sel, kf, ka)
+                pa = jnp.where(sel, pf, pa)
+            acc_ref[:, 0:K] = ka
+            acc_ref[:, K:2 * K] = pa
+        return ()
+
+    jax.lax.fori_loop(0, iters, body, (), unroll=False)
+    o_ref[:] = acc_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("mode", "f", "iters",
+                                             "interpret"))
+def probe(x, *, mode: str, f: int, iters: int, interpret: bool = False):
+    b = x.shape[0]
+    return pl.pallas_call(
+        functools.partial(_kernel, mode, f, iters),
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, 2 * K), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((b, 2 * K), jnp.int32)],
+        interpret=interpret,
+    )(x)
+
+
+def main():
+    b = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    f = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    iters = int(sys.argv[3]) if len(sys.argv) > 3 else 2000
+    assert f % 2 == 0, "packed mode pairs rounds; use an even F"
+    print(f"backend={jax.default_backend()} B={b} F={f} iters={iters}",
+          flush=True)
+    rng = np.random.default_rng(0)
+    for mode in ("narrow", "wide", "packed"):
+        try:
+            xs = [jnp.asarray(rng.integers(-4, 1 << 20, (b, 2 * K)),
+                              jnp.int32) for _ in range(4)]
+            out = jax.block_until_ready(
+                probe(xs[0], mode=mode, f=f, iters=iters))
+            np.asarray(out)
+            best = 1e9
+            for i in (1, 2, 3):
+                t0 = time.perf_counter()
+                out = jax.block_until_ready(
+                    probe(xs[i], mode=mode, f=f, iters=iters))
+                np.asarray(out)
+                best = min(best, time.perf_counter() - t0)
+            per_round = best / iters / f * 1e6
+            print(f"{mode:7s}  {best:7.4f}s  {per_round:6.3f} us/round",
+                  flush=True)
+        except Exception as e:  # noqa: BLE001 — probing compiler limits
+            print(f"{mode:7s}  REJECTED: {str(e)[:200]}", flush=True)
+
+
+if __name__ == "__main__" and not (len(sys.argv) > 1
+                                   and sys.argv[1] == "col"):
+    main()
+
+
+def _colchain(x, t):
+    """~24-op per-row decision chain (sched_of/drop-hash-like mix)."""
+    xu = x.astype(jnp.uint32)
+    h = (xu ^ (jnp.uint32(t) + jnp.uint32(0x85EBCA6B))) \
+        * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0x27D4EB2F)
+    h = h ^ (h >> 16)
+    fail = jnp.where(h < jnp.uint32(1 << 29), (x & 1023) + 7, 1 << 30)
+    rejoin = jnp.where(fail < (1 << 30), fail + 40, 1 << 30)
+    failed = (t > fail) & (t <= rejoin)
+    ramp = x * 3
+    proc = (ramp < t * 4) & ~failed
+    at_start = (ramp >= t * 4) & (ramp < (t + 1) * 4)
+    g = (h >> 5) < jnp.uint32(1 << 28)
+    out = jnp.where(proc & ~g, x + 1, x)
+    return jnp.where(at_start, out + 2, out)
+
+
+def _colkernel(mode: str, iters: int, x_ref, o_ref):
+    b = x_ref.shape[0]
+
+    def body(s, _):
+        if mode == "col":
+            v = x_ref[:, 0:1] + s
+            for _ in range(4):
+                v = _colchain(v, s & 15)
+            o_ref[:, 0:1] = v
+        else:                               # flat (b/128, 128)
+            v = x_ref[:].reshape(b // 128, 128) + s
+            for _ in range(4):
+                v = _colchain(v, s & 15)
+            o_ref[:] = v.reshape(b, 1)
+        return ()
+
+    jax.lax.fori_loop(0, iters, body, (), unroll=False)
+
+
+@functools.partial(jax.jit, static_argnames=("mode", "iters", "interpret"))
+def colprobe(x, *, mode: str, iters: int, interpret: bool = False):
+    b = x.shape[0]
+    return pl.pallas_call(
+        functools.partial(_colkernel, mode, iters),
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, 1), jnp.int32),
+        interpret=interpret,
+    )(x)
+
+
+def colmain():
+    b = int(sys.argv[2]) if len(sys.argv) > 2 else 512
+    iters = int(sys.argv[3]) if len(sys.argv) > 3 else 50000
+    print(f"backend={jax.default_backend()} B={b} iters={iters} "
+          f"(4 chains of ~24 col ops per iter)", flush=True)
+    rng = np.random.default_rng(0)
+    for mode in ("col", "flat"):
+        try:
+            xs = [jnp.asarray(rng.integers(0, 1 << 20, (b, 1)), jnp.int32)
+                  for _ in range(4)]
+            out = jax.block_until_ready(colprobe(xs[0], mode=mode,
+                                                 iters=iters))
+            np.asarray(out)
+            best = 1e9
+            for i in (1, 2, 3):
+                t0 = time.perf_counter()
+                out = jax.block_until_ready(
+                    colprobe(xs[i], mode=mode, iters=iters))
+                np.asarray(out)
+                best = min(best, time.perf_counter() - t0)
+            print(f"{mode:5s}  {best:7.4f}s  "
+                  f"{best / iters * 1e6:7.3f} us/iter", flush=True)
+        except Exception as e:  # noqa: BLE001 — probing compiler limits
+            print(f"{mode:5s}  REJECTED: {str(e)[:200]}", flush=True)
+
+
+if __name__ == "__main__" and len(sys.argv) > 1 and sys.argv[1] == "col":
+    colmain()
