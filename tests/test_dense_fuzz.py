@@ -1,0 +1,111 @@
+"""Randomized differential sweep over the dense path-selection matrix.
+
+make_run can route a dense config four ways — per-tick XLA, per-tick
+fused (Pallas), whole-run megakernel, active-corner (which itself may
+ride the megakernel) — and the choice depends on n, total_ticks,
+with_events, use_pallas, backend, and the schedule.  The scenario
+tests pin specific configs; this sweep draws random small configs and
+asserts the paths that are defined to share a drop stream stay
+bitwise identical, so a routing or envelope change that silently
+shifts one path's semantics trips here rather than in a bench run.
+
+Streams: the interpret-mode fused/mega paths and the per-tick XLA
+path all draw at full width; the corner path draws at width A and is
+compared against the full path pinned to the same width
+(``make_tick(n_active=A)``) — the equivalence dense_corner.py
+documents.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gossip_protocol_tpu.config import SimConfig
+from gossip_protocol_tpu.core.dense_corner import (active_bound,
+                                                   make_corner_run)
+from gossip_protocol_tpu.core.dense_mega import (dense_mega_supported,
+                                                 make_dense_mega_run)
+from gossip_protocol_tpu.core.tick import make_tick
+from gossip_protocol_tpu.state import init_state, make_schedule
+
+STATE_FIELDS = ("tick", "in_group", "own_hb", "known", "hb", "ts",
+                "gossip", "joinreq", "joinrep")
+
+
+def _random_cfg(rng: np.random.Generator) -> SimConfig:
+    n = int(rng.choice([16, 24, 32, 48, 64]))
+    total = int(rng.integers(20, 90))
+    drop = bool(rng.integers(0, 2))
+    churn = bool(rng.integers(0, 3) == 0)
+    kw = dict(max_nnb=n, total_ticks=total,
+              single_failure=bool(rng.integers(0, 2)),
+              fail_tick=int(rng.integers(5, max(6, total - 5))),
+              seed=int(rng.integers(0, 1 << 16)))
+    if drop:
+        lo = int(rng.integers(0, total // 2))
+        kw.update(drop_msg=True,
+                  msg_drop_prob=float(rng.uniform(0.05, 0.4)),
+                  drop_open_tick=lo,
+                  drop_close_tick=int(rng.integers(lo + 5, total + 50)))
+    else:
+        kw["drop_msg"] = False
+    if churn:
+        kw["rejoin_after"] = int(rng.integers(5, 40))
+    return SimConfig(**kw)
+
+
+def _scan_run(tick, total):
+    @jax.jit
+    def run(state, sched):
+        def step(c, _):
+            c, ev = tick(c, sched)
+            return c, (ev.sent, ev.recv)
+        return jax.lax.scan(step, state, None, length=total)
+    return run
+
+
+def _assert_states(fa, fb, tag, cfg):
+    for name in STATE_FIELDS:
+        x, y = np.asarray(getattr(fa, name)), np.asarray(getattr(fb, name))
+        assert np.array_equal(x, y), \
+            f"{tag}: field {name} diverged for {cfg}"
+
+
+@pytest.mark.parametrize("trial", range(12))
+def test_random_config_paths_agree(trial):
+    rng = np.random.default_rng(1000 + trial)
+    cfg = _random_cfg(rng)
+    sched, state = make_schedule(cfg), init_state(cfg)
+    total = cfg.total_ticks
+
+    # reference trajectory: per-tick composable XLA
+    run_x = _scan_run(make_tick(cfg, use_pallas=False, with_events=False),
+                      total)
+    fx, (sx, rx) = run_x(state, sched)
+
+    # per-tick fused (interpret-mode Pallas kernels)
+    run_f = _scan_run(make_tick(cfg, use_pallas=True, with_events=False),
+                      total)
+    ff, (sf, rf) = run_f(state, sched)
+    _assert_states(fx, ff, "fused", cfg)
+    np.testing.assert_array_equal(np.asarray(sx), np.asarray(sf))
+
+    # whole-run megakernel (same full-width stream)
+    if dense_mega_supported(cfg):
+        fm, em = make_dense_mega_run(cfg)(state, sched)
+        _assert_states(fx, fm, "mega", cfg)
+        np.testing.assert_array_equal(np.asarray(sx), np.asarray(em.sent))
+        np.testing.assert_array_equal(np.asarray(rx), np.asarray(em.recv))
+
+    # corner (width-A stream) vs full path pinned to the same stream
+    a = active_bound(cfg)
+    if 0 < a < cfg.n:
+        run_a = _scan_run(
+            make_tick(cfg, use_pallas=False, with_events=False, n_active=a),
+            total)
+        fa, (sa, ra) = run_a(state, sched)
+        fc, ec = make_corner_run(cfg, a, use_pallas=False)(state, sched)
+        _assert_states(fa, fc, "corner", cfg)
+        np.testing.assert_array_equal(np.asarray(sa), np.asarray(ec.sent))
+        np.testing.assert_array_equal(np.asarray(ra), np.asarray(ec.recv))
