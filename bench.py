@@ -110,6 +110,16 @@ def _roofline(cfg, ticks_per_s: float, backend: str) -> dict:
             bytes_per_tick = plane * (2 + f)
             out["path"] = "grid"
             out["bound"] = "hbm + in-kernel vpu"
+            # the run executes through the schedule-segment planner
+            # (OverlaySimulation pins start_tick=0): one specialized
+            # kernel variant per segment, dead phases statically
+            # elided (models/segments.py)
+            from gossip_protocol_tpu.models.segments import (
+                describe_plan, plan_segments)
+            from gossip_protocol_tpu.ops.pallas.overlay_grid import \
+                GRID_TICKS
+            out["segments"] = describe_plan(
+                plan_segments(cfg, cfg.total_ticks, 0, GRID_TICKS))
         else:
             bytes_per_tick = plane * ((1 + f) * 2 + 3)
             out["path"] = "fused"

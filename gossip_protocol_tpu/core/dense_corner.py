@@ -65,6 +65,12 @@ def active_bound(cfg: SimConfig) -> int:
     capped at N.
     """
     n, total = cfg.n, cfg.total_ticks
+    if cfg.step_rate < 0:
+        # the bisection requires start_tick(i) nondecreasing in i; a
+        # negative step_rate (the field is an unvalidated float) breaks
+        # that, so fall back to the full width instead of miscomputing
+        # the corner (ADVICE round 5, item 2)
+        return n
     if (cfg.rejoin_after is not None
             and cfg.fail_tick + cfg.rejoin_after < total):
         return n
@@ -78,6 +84,22 @@ def active_bound(cfg: SimConfig) -> int:
         else:
             lo = mid + 1
     return min(n, -(-lo // 128) * 128)
+
+
+def bench_stream_width(cfg: SimConfig) -> int:
+    """Width at which a bench-mode run draws its drop stream.
+
+    Mirrors ``make_run``'s corner routing: the corner path draws at
+    width ``A = active_bound(cfg)``, every other path at ``N``.  For a
+    drop config with ``A < N`` the bench counters therefore consume a
+    *different, equally seeded* realization of the drop process than a
+    trace-mode run of the same seed (see the module docstring) —
+    ``SimResult.counter_stream_width`` carries this value so
+    downstream tooling can detect when bench and trace counters are
+    not bit-comparable (ADVICE round 5, item 3).
+    """
+    a = active_bound(cfg)
+    return a if 0 < a < cfg.n else cfg.n
 
 
 def _slice_state(state: WorldState, a: int) -> WorldState:
@@ -107,7 +129,8 @@ def _embed_state(state_a: WorldState, n: int) -> WorldState:
 
 
 def make_corner_run(cfg: SimConfig, a: int, block_size: int = 128,
-                    use_pallas: bool | None = None):
+                    use_pallas: bool | None = None,
+                    force_mega: bool | None = None):
     """Bench-mode whole-run function on the ``a x a`` active corner.
 
     Same contract as ``make_run(cfg, with_events=False)``: a
@@ -116,6 +139,17 @@ def make_corner_run(cfg: SimConfig, a: int, block_size: int = 128,
     fits the dense megakernel envelope the launches ride it (the
     BASELINE N=4096 / 200-tick shape has A = 896; a corner of <= 512
     arises for longer-N, shorter-T points).
+
+    ``active_bound`` is computed against the run's *absolute* tick
+    horizon, so the corner is only valid for runs that begin at tick 0
+    — the returned run raises otherwise (ADVICE round 5, item 1;
+    ``Simulation.run_bench`` always starts from ``init_state``).
+
+    ``force_mega`` overrides the megakernel auto-selection (None).
+    Forcing it on a non-TPU backend runs the megakernel in interpret
+    mode with eager launches — the CI differential path for the
+    corner+mega combination (tests/test_dense_fuzz.py), which
+    otherwise only executes on hardware.
     """
     from ..parallel.comm import LocalComm
     from .dense_mega import dense_mega_supported, make_dense_mega_run
@@ -125,10 +159,13 @@ def make_corner_run(cfg: SimConfig, a: int, block_size: int = 128,
     assert 0 < a < n and a % 8 == 0
     cfg_a = cfg.replace(max_nnb=a)
     comm = LocalComm(use_pallas)
-    mega = (comm.use_pallas and dense_mega_supported(cfg_a)
-            and jax.default_backend() == "tpu")
+    on_tpu = jax.default_backend() == "tpu"
+    mega = (comm.use_pallas and dense_mega_supported(cfg_a) and on_tpu) \
+        if force_mega is None else force_mega
     if mega:
-        inner = make_dense_mega_run(cfg_a, with_events=False, as_body=True)
+        assert dense_mega_supported(cfg_a), (a, cfg_a.n)
+        inner = make_dense_mega_run(cfg_a, with_events=False,
+                                    as_body=on_tpu)
     else:
         tick = make_tick(cfg_a, block_size, use_pallas=comm.use_pallas,
                          with_events=False)
@@ -158,10 +195,37 @@ def make_corner_run(cfg: SimConfig, a: int, block_size: int = 128,
                         recv=jnp.pad(ev.recv, pad))
         return _embed_state(final_a, n), ev
 
-    if jax.default_backend() == "tpu":
+    def _check_clock(state: WorldState):
+        tick = state.tick
+        if isinstance(tick, jax.core.Tracer):
+            # the corner's validity depends on the absolute clock —
+            # refuse an unverifiable (traced) one rather than risk a
+            # silently wrong corner on a resumed state
+            raise ValueError(
+                "active-corner run cannot verify its tick-0 "
+                "precondition under a traced state; call it outside "
+                "jit (Simulation.run_bench does)")
+        if int(tick) != 0:
+            raise ValueError(
+                f"active-corner run requires a tick-0 start (the bound "
+                f"spans the whole {cfg.total_ticks}-tick horizon), got "
+                f"tick {int(tick)}")
+
+    if on_tpu:
         # same raised scoped-VMEM window as make_dense_mega_run: the
         # megakernel (and the fused epilogue at larger corners) runs
         # inlined under this jit
-        return jax.jit(run_body, compiler_options={
+        inner_run = jax.jit(run_body, compiler_options={
             "xla_tpu_scoped_vmem_limit_kib": "114688"})
-    return jax.jit(run_body)
+    elif mega:
+        # forced interpret-mode megakernel: eager launches (inlining
+        # interpret kernels under jit blows up the XLA:CPU compile)
+        inner_run = run_body
+    else:
+        inner_run = jax.jit(run_body)
+
+    def run(state: WorldState, sched: Schedule):
+        _check_clock(state)
+        return inner_run(state, sched)
+
+    return run

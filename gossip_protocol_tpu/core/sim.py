@@ -109,6 +109,13 @@ class SimResult:
     wall_seconds: float
     first_tick: int = 0      # absolute tick of added[0] (0 unless resumed)
     resumed: bool = False    # True for a continuation segment (no boot lines)
+    #: width at which the run drew its drop stream (None: full width).
+    #: Bench runs routed through the active corner draw at width
+    #: A < N, so their sent/recv counters are a different — equally
+    #: seeded — realization of the drop process than a trace run of
+    #: the same seed; compare counters across modes only when this
+    #: equals cfg.n (core/dense_corner.py bench_stream_width).
+    counter_stream_width: Optional[int] = None
 
     def events(self) -> list[LogEvent]:
         assert self.added is not None, "events need a trace-mode run"
@@ -252,7 +259,18 @@ class Simulation:
         )
 
     def run_bench(self, seed: Optional[int] = None, warmup: bool = True) -> SimResult:
-        """Bench-mode run: whole simulation on device, timed end-to-end."""
+        """Bench-mode run: whole simulation on device, timed end-to-end.
+
+        Always starts from ``init_state`` (tick 0) — the active-corner
+        routing derives its width from the whole-run horizon and its
+        run function rejects any other clock.  For drop configs whose
+        ``active_bound < N`` the corner draws the drop stream at the
+        corner width, so the returned sent/recv counters are NOT
+        bit-comparable to a trace-mode ``run()`` of the same seed
+        (statistically equivalent realizations of the same process);
+        ``SimResult.counter_stream_width`` records the width drawn.
+        """
+        from .dense_corner import bench_stream_width
         cfg = self.cfg if seed is None else self.cfg.replace(seed=seed)
         sched = make_schedule(cfg)
         if self._bench_run is None:
@@ -284,6 +302,7 @@ class Simulation:
             recv=np.asarray(ev.recv).T.copy(),
             final_state=state,
             wall_seconds=wall,
+            counter_stream_width=bench_stream_width(cfg),
         )
 
 

@@ -93,7 +93,6 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from fractions import Fraction
 
 import jax
 import jax.numpy as jnp
@@ -206,15 +205,18 @@ class OverlaySchedule:
 def make_overlay_schedule(cfg: SimConfig) -> OverlaySchedule:
     from ..utils.prng import fail_schedule_uniform
 
+    # the shared step-rate fraction (models/segments.py): schedule,
+    # grid harness, and segment planner must agree on it exactly
+    from .segments import step_fraction
     n = cfg.n
-    frac = Fraction(cfg.step_rate).limit_denominator(1 << 15)
+    step_num, step_den = step_fraction(cfg.step_rate)
     if cfg.churn_rate > 0:
         # the churn window must not overlap the start ramp: a churned
         # peer whose fail tick precedes its start would be introduced
         # while failed (a posthumous join — reference-faithful in the
         # dense model, but it would suspend the overlay's victim-purge
         # guarantee).  Require the ramp to finish before churn opens.
-        last_start = (n - 1) * frac.numerator // max(frac.denominator, 1)
+        last_start = (n - 1) * step_num // step_den
         churn_lo = cfg.total_ticks // 4
         if last_start >= churn_lo:
             raise ValueError(
@@ -232,8 +234,8 @@ def make_overlay_schedule(cfg: SimConfig) -> OverlaySchedule:
             victim_hi = victim_lo + n // 2
     return OverlaySchedule(
         seed=jnp.uint32(cfg.seed & 0xFFFFFFFF),
-        step_num=jnp.int32(frac.numerator),
-        step_den=jnp.int32(max(frac.denominator, 1)),
+        step_num=jnp.int32(step_num),
+        step_den=jnp.int32(step_den),
         victim_lo=jnp.int32(victim_lo),
         victim_hi=jnp.int32(victim_hi),
         fail_tick=jnp.int32(cfg.fail_tick),
@@ -962,7 +964,8 @@ _OVERLAY_RUN_CACHE: dict = {}
 
 
 def make_overlay_run(cfg: SimConfig, length: int | None = None,
-                     use_pallas: bool | None = None):
+                     use_pallas: bool | None = None,
+                     start_tick: int | None = None):
     """``lax.scan`` over ``length`` ticks (default: the whole run):
     ``run(state, sched) -> (final, metrics[length])``.  The schedule is
     closed-form in the absolute clock carried in the state, so a
@@ -974,7 +977,14 @@ def make_overlay_run(cfg: SimConfig, length: int | None = None,
     VMEM — bit-identical to the per-tick path, but without the
     per-launch dispatch floor.  Its one observable difference:
     per-tick ``live_uncovered`` is the "not tracked" sentinel -1
-    (coverage is still validated on the final state host-side)."""
+    (coverage is still validated on the final state host-side).
+
+    ``start_tick`` pins the run's absolute start tick at trace time;
+    it only affects the grid path, which then compiles
+    schedule-segmented kernel variants (models/segments.py) —
+    bit-identical to the unsegmented run but with dead protocol
+    phases statically elided per segment.  Leave it ``None`` when the
+    caller resumes from arbitrary clocks."""
     length = cfg.total_ticks if length is None else length
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
@@ -990,7 +1000,12 @@ def make_overlay_run(cfg: SimConfig, length: int | None = None,
            cfg.topology, cfg.total_ticks, mega, grid,
            cfg.churn_rate > 0 or cfg.rejoin_after is not None,
            # the grid kernel bakes churn-vs-scripted statically
-           cfg.churn_rate > 0)
+           cfg.churn_rate > 0,
+           # the segment plan is a function of the pinned start tick
+           start_tick if grid else None,
+           cfg.step_rate if grid else None,
+           (cfg.drop_msg, cfg.drop_open_tick, cfg.drop_close_tick,
+            cfg.fail_tick, cfg.rejoin_after) if grid else None)
     if key in _OVERLAY_RUN_CACHE:
         return _OVERLAY_RUN_CACHE[key]
     if mega:
@@ -998,7 +1013,7 @@ def make_overlay_run(cfg: SimConfig, length: int | None = None,
         _OVERLAY_RUN_CACHE[key] = run
         return run
     if grid:
-        run = make_grid_run(cfg, length)
+        run = make_grid_run(cfg, length, start_tick=start_tick)
         _OVERLAY_RUN_CACHE[key] = run
         return run
     tick = make_overlay_tick(cfg, use_pallas=use_pallas)
@@ -1104,7 +1119,9 @@ class OverlaySimulation:
             raise ValueError("OverlaySimulation requires cfg.model='overlay'")
         self.cfg = cfg
         self.use_pallas = use_pallas
-        make_overlay_run(cfg, use_pallas=use_pallas)   # pre-build/cache
+        # pre-build/cache the whole-run function (fresh runs start at
+        # tick 0, which is what run() requests for non-resumed runs)
+        make_overlay_run(cfg, use_pallas=use_pallas, start_tick=0)
 
     def run(self, profile_dir=None, resume_from: OverlayState | None = None,
             ticks: int | None = None):
@@ -1134,7 +1151,11 @@ class OverlaySimulation:
             raise ValueError(f"ticks must be >= 0, got {ticks}")
         t_end = cfg.total_ticks if ticks is None \
             else min(cfg.total_ticks, first + ticks)
-        run = make_overlay_run(cfg, t_end - first, use_pallas=self.use_pallas)
+        # the start tick is concrete here, so the grid path can route
+        # through the segment planner (schedule-specialized variants)
+        run = make_overlay_run(cfg, t_end - first,
+                               use_pallas=self.use_pallas,
+                               start_tick=first)
         t0 = time.perf_counter()
         final, metrics = run(state, sched)
         jax.block_until_ready(final)

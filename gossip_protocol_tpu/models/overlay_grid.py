@@ -20,8 +20,6 @@ launch floor and eliminates the XLA tail entirely.
 
 from __future__ import annotations
 
-from fractions import Fraction
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -39,8 +37,11 @@ from .overlay import (SLOT_EPOCH, OverlayMetrics, OverlaySchedule,
 
 
 def _step_frac(cfg: SimConfig):
-    frac = Fraction(cfg.step_rate).limit_denominator(1 << 15)
-    return frac.numerator, max(frac.denominator, 1)
+    # the one shared definition (models/segments.py): the planner's
+    # last_start and the kernel's runtime step_num/step_den ramp MUST
+    # come from the same fraction or phase elision goes bit-wrong
+    from .segments import step_fraction
+    return step_fraction(cfg.step_rate)
 
 
 def grid_supported(cfg: SimConfig) -> bool:
@@ -149,20 +150,35 @@ def _sp_vector(sched: OverlaySchedule, t0, s_ticks: int, n: int, f: int):
 
 
 def make_grid_run(cfg: SimConfig, length: int,
-                  block_rows: int = GRID_BLOCK_ROWS):
+                  block_rows: int = GRID_BLOCK_ROWS,
+                  start_tick: int | None = None,
+                  grid_ticks: int = GRID_TICKS):
     """``run(state, sched) -> (final, OverlayMetrics[length])`` via
-    whole-``GRID_TICKS`` grid-kernel launches (same contract as
+    whole-``grid_ticks`` grid-kernel launches (same contract as
     :func:`~.overlay.make_overlay_run`).
 
-    On TPU the launches run inside one jitted ``lax.scan``; on other
-    backends each launch dispatches eagerly (inlining interpret-mode
-    kernels into a jitted scan blows up the XLA:CPU compile — see
-    overlay_mega.make_mega_run)."""
+    ``start_tick`` pins the run's absolute start tick at trace time
+    and unlocks **schedule-segmented** execution (models/segments.py):
+    the run splits at the closed-form phase boundaries and each
+    segment executes a kernel variant with the dead phases statically
+    removed — bit-identical to the all-live kernel, verified by
+    tests/test_segments.py.  ``start_tick=None`` (callers that resume
+    from arbitrary clocks, e.g. bench.py's coverage walk) compiles the
+    single all-live variant, valid at any clock.  When a start tick is
+    pinned, the returned run raises if called with a state whose
+    (concrete) clock differs — the segment flags would describe the
+    wrong absolute ticks.
+
+    On TPU the launches run inside one jitted ``lax.scan`` per
+    same-flag segment; on other backends each launch dispatches
+    eagerly (inlining interpret-mode kernels into a jitted scan blows
+    up the XLA:CPU compile — see overlay_mega.make_mega_run)."""
+    from .segments import plan_segments
     assert grid_supported(cfg), "config outside the grid-kernel envelope"
     n = cfg.n
     k, f = resolved_dims(cfg)
     b = min(block_rows, n)
-    n_chunks, rem = divmod(length, GRID_TICKS)
+    plan = plan_segments(cfg, length, start_tick, grid_ticks)
     kern_kw = dict(n=n, k=k, f_rounds=f, b=b, t_remove=cfg.t_remove,
                    churn_lo=cfg.total_ticks // 4,
                    churn_span=max(cfg.total_ticks // 2, 1),
@@ -184,12 +200,13 @@ def make_grid_run(cfg: SimConfig, length: int,
             recv=met[:, MET_RECV],
         )
 
-    def launch(plane, t, sched, s_ticks: int):
+    def launch(plane, t, sched, s_ticks: int, flags):
         init = jnp.concatenate([plane, _boot_rows(cfg, sched, plane, t)],
                                axis=0)
         sp = _sp_vector(sched, t, s_ticks, n, f)
         plane2, met = grid_overlay_ticks(init, sp, s_ticks=s_ticks,
-                                         **kern_kw)
+                                         **kern_kw,
+                                         **flags.as_kernel_kwargs())
         return plane2[s_ticks % 2], t + s_ticks, met
 
     def assemble(plane, t, met_parts):
@@ -197,21 +214,45 @@ def make_grid_run(cfg: SimConfig, length: int,
             else jnp.zeros((0, 128), jnp.int32)
         return unpack_grid_plane(cfg, plane, t), _metrics(met)
 
+    def _check_clock(state: OverlayState):
+        if start_tick is None:
+            return
+        tick = state.tick
+        if isinstance(tick, jax.core.Tracer):
+            # a pinned plan applied at an unverifiable clock would
+            # elide phases on the wrong absolute ticks — refuse
+            # rather than silently compute a bit-wrong trajectory
+            raise ValueError(
+                "segmented grid run cannot verify its pinned start "
+                f"tick ({start_tick}) under a traced state; call it "
+                "outside jit, or build with start_tick=None for the "
+                "clock-agnostic unsegmented variant")
+        if int(tick) != start_tick:
+            raise ValueError(
+                f"segmented grid run was planned for start tick "
+                f"{start_tick} but the state is at tick {int(tick)}; "
+                "build the run with the matching start_tick (or None "
+                "for the unsegmented variant)")
+
     def run_body(state: OverlayState, sched: OverlaySchedule):
         plane = pack_grid_plane(cfg, state)
         t = state.tick
         met_parts = []
-        if n_chunks:
-            def step(carry, _):
-                plane, t, met = launch(carry[0], carry[1], sched,
-                                       GRID_TICKS)
-                return (plane, t), met
-            (plane, t), met_main = jax.lax.scan(
-                step, (plane, t), None, length=n_chunks)
-            met_parts.append(met_main.reshape(n_chunks * GRID_TICKS, 128))
-        if rem:
-            plane, t, met_rem = launch(plane, t, sched, rem)
-            met_parts.append(met_rem)
+        for seg in plan:
+            n_chunks, rem = divmod(seg.ticks, grid_ticks)
+            if n_chunks:
+                def step(carry, _, _flags=seg.flags):
+                    plane, t, met = launch(carry[0], carry[1], sched,
+                                           grid_ticks, _flags)
+                    return (plane, t), met
+                (plane, t), met_main = jax.lax.scan(
+                    step, (plane, t), None, length=n_chunks)
+                met_parts.append(
+                    met_main.reshape(n_chunks * grid_ticks, 128))
+            if rem:
+                plane, t, met_rem = launch(plane, t, sched, rem,
+                                           seg.flags)
+                met_parts.append(met_rem)
         return assemble(plane, t, met_parts)
 
     if jax.default_backend() == "tpu":
@@ -220,19 +261,29 @@ def make_grid_run(cfg: SimConfig, length: int,
         # default 16 MB scoped window together with the kernel's row
         # blocks; v5e has 128 MB of physical VMEM (at large N XLA
         # falls back to HBM on its own)
-        return jax.jit(run_body, compiler_options={
+        run_tpu = jax.jit(run_body, compiler_options={
             "xla_tpu_scoped_vmem_limit_kib": "98304"})
 
+        def run_checked(state: OverlayState, sched: OverlaySchedule):
+            _check_clock(state)
+            return run_tpu(state, sched)
+
+        return run_checked
+
     def run_eager(state: OverlayState, sched: OverlaySchedule):
+        _check_clock(state)
         plane = pack_grid_plane(cfg, state)
         t = state.tick
         met_parts = []
-        for _ in range(n_chunks):
-            plane, t, met = launch(plane, t, sched, GRID_TICKS)
-            met_parts.append(met)
-        if rem:
-            plane, t, met = launch(plane, t, sched, rem)
-            met_parts.append(met)
+        for seg in plan:
+            n_chunks, rem = divmod(seg.ticks, grid_ticks)
+            for _ in range(n_chunks):
+                plane, t, met = launch(plane, t, sched, grid_ticks,
+                                       seg.flags)
+                met_parts.append(met)
+            if rem:
+                plane, t, met = launch(plane, t, sched, rem, seg.flags)
+                met_parts.append(met)
         return assemble(plane, t, met_parts)
 
     return run_eager

@@ -140,7 +140,8 @@ def make_sharded_overlay_run(cfg: SimConfig, mesh: Mesh,
             return tick(carry, sched)
         return jax.lax.scan(step, state, None, length=cfg.total_ticks)
 
-    shmapped = jax.shard_map(
+    from ..compat.jaxapi import shard_map
+    shmapped = shard_map(
         body, mesh=mesh,
         in_specs=(_state_specs(axis), _sched_specs()),
         out_specs=(_state_specs(axis), _metric_specs()),

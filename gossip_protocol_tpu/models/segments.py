@@ -1,0 +1,218 @@
+"""Schedule-segmented planning for the grid-scale overlay kernel.
+
+The protocol's epochs are all closed-form counter functions of the
+config (models/overlay.py OverlaySchedule): the join ramp ends at
+``start(N-1)``, churn/scripted failures and rejoins live in a bounded
+tick window, and the drop window is ``(drop_open, drop_close]``.  The
+grid megakernel (ops/pallas/overlay_grid.py) nevertheless paid the
+full fixed per-step op budget — join scratch revolving, JOINREQ
+aggregation, JOINREP winner extraction, ramp comparisons, churn-hash
+wipes, drop masking — on **every** tick, and that kernel is
+op-issue-bound, not bandwidth-bound (docs/PERF.md §1/§3).
+
+This module derives, on host at trace time, the tick at which each
+phase goes *provably dead* and splits a run into launch-aligned
+segments tagged with four static liveness flags.  Each distinct flag
+combination compiles one specialized grid-kernel variant; the
+steady-state variant drops all four phases from the hot loop.  It is
+the temporal analogue of the spatial prefix `core/dense_corner.py`
+derives from the same closed-form schedule.
+
+Flag semantics (each one OFF is a *guarantee* over every tick the
+launch computes; the kernel elides the phase statically):
+
+* ``ramp_live`` off: every peer's start tick precedes every tick of
+  the launch — ``t > start(i)`` holds for all rows and no ``at_start``
+  event can fire.  Dead from ``last_start + 1``.
+* ``churn_live`` off: no row is inside its fail window and no row
+  rejoins at any tick of the launch (``failed`` and ``rejoining`` are
+  identically False, for the introducer too) — the per-row fail/rejoin
+  hashes and the wipe-on-load disappear.  Dead outside
+  ``[first_fail, last_rejoin]``; a no-rejoin scripted failure keeps it
+  live from ``fail_tick`` onward (victims stay failed forever).
+* ``join_live`` off: the joinreq/joinrep in-flight bits are provably
+  zero at the launch's start and no join/rejoin event can set them
+  during it — JOINREQ aggregation, the JOINREP broadcast merge, the
+  introducer's winner extraction, and the broadcast-row revolve all
+  disappear.  Flags drain within 3 ticks of the last possible
+  ``starting`` event (set at T, answered at T+1, consumed or dropped
+  by T+2 — a failed introducer *drops* pending JOINREQs, it does not
+  hold them), so dead from ``max(last_start, last_rejoin) + 3``.
+* ``drop_live`` off: the drop window does not intersect the launch —
+  the three per-tick Bernoulli hash streams disappear.
+
+Every bound is derived from the config alone (never from the seed):
+the compiled run is cached per config and reseeded through the
+schedule arrays, and seeds move *which* rows fail, never the windows.
+
+Launch alignment matters for exactness: the in-kernel JOINREQ
+aggregate lookahead computes tick ``t+1`` state only for ticks whose
+successor is inside the same launch (the host recomputes the boot
+aggregate at every launch boundary), so per-launch flags need only
+cover the launch's own ticks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from fractions import Fraction
+
+from ..config import SimConfig
+
+#: sentinel for "never happens within any representable run"
+_INF = 1 << 30
+
+
+@dataclasses.dataclass(frozen=True)
+class PhaseFlags:
+    """Static per-launch phase liveness (kernel specialization key)."""
+
+    ramp_live: bool
+    churn_live: bool
+    join_live: bool
+    drop_live: bool
+
+    def as_kernel_kwargs(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @property
+    def tag(self) -> str:
+        """Compact label, e.g. ``"ramp+join"`` or ``"steady"``."""
+        parts = [name for name, on in (
+            ("ramp", self.ramp_live), ("churn", self.churn_live),
+            ("join", self.join_live), ("drop", self.drop_live)) if on]
+        return "+".join(parts) if parts else "steady"
+
+
+ALL_LIVE = PhaseFlags(True, True, True, True)
+
+
+@dataclasses.dataclass(frozen=True)
+class Segment:
+    """A run of consecutive same-flag launches.
+
+    ``start`` is the absolute tick of the segment's first tick and
+    ``ticks`` its length; every segment is a whole number of
+    ``grid_ticks`` launches except possibly the final one.
+    """
+
+    start: int
+    ticks: int
+    flags: PhaseFlags
+
+
+@dataclasses.dataclass(frozen=True)
+class PhaseWindows:
+    """Inclusive tick windows in which each phase can be live."""
+
+    last_start: int       # last tick with a scheduled nodeStart
+    fail_lo: int          # first tick any fail window can open
+    rejoin_hi: int        # last tick any row can be failed/rejoining
+    #                       (_INF: no rejoin — failures are permanent)
+    join_dead_from: int   # first tick with provably-zero join flags
+    drop_lo: int          # first tick the drop window covers
+    drop_hi: int          # last tick the drop window covers (-1: off)
+
+
+def step_fraction(step_rate: float) -> tuple[int, int]:
+    """(num, den) of the start-ramp rate (shared with the grid
+    harness so the planner and the kernel agree on ``last_start``)."""
+    frac = Fraction(step_rate).limit_denominator(1 << 15)
+    return frac.numerator, max(frac.denominator, 1)
+
+
+def phase_windows(cfg: SimConfig) -> PhaseWindows:
+    """Seed-independent closed-form liveness windows of a config."""
+    n, total = cfg.n, cfg.total_ticks
+    num, den = step_fraction(cfg.step_rate)
+    last_start = (n - 1) * num // den
+    if cfg.churn_rate > 0:
+        # churn fail ticks are hashed into [lo, lo + span); rejoin
+        # follows ``churn_after`` ticks later (make_overlay_schedule)
+        fail_lo = total // 4
+        fail_hi = fail_lo + max(total // 2, 1) - 1
+        after = cfg.rejoin_after if cfg.rejoin_after is not None else 40
+        rejoin_hi = fail_hi + after
+    else:
+        fail_lo = fail_hi = cfg.fail_tick
+        rejoin_hi = cfg.fail_tick + cfg.rejoin_after \
+            if cfg.rejoin_after is not None else _INF
+    last_join_event = last_start if rejoin_hi >= _INF \
+        else max(last_start, rejoin_hi)
+    return PhaseWindows(
+        last_start=last_start,
+        fail_lo=fail_lo,
+        rejoin_hi=rejoin_hi,
+        join_dead_from=last_join_event + 3,
+        drop_lo=cfg.drop_open_tick + 1 if cfg.drop_msg else 0,
+        drop_hi=cfg.drop_close_tick if cfg.drop_msg else -1,
+    )
+
+
+def flags_at(win: PhaseWindows, t: int) -> PhaseFlags:
+    """Phase liveness at one absolute tick (conservative)."""
+    return PhaseFlags(
+        ramp_live=t <= win.last_start,
+        churn_live=win.fail_lo <= t <= win.rejoin_hi,
+        join_live=t < win.join_dead_from,
+        drop_live=win.drop_lo <= t <= win.drop_hi,
+    )
+
+
+def _launch_flags(win: PhaseWindows, t0: int, ticks: int) -> PhaseFlags:
+    """OR of per-tick liveness over a launch window [t0, t0+ticks)."""
+    f = [flags_at(win, t) for t in range(t0, t0 + ticks)]
+    return PhaseFlags(
+        ramp_live=any(x.ramp_live for x in f),
+        churn_live=any(x.churn_live for x in f),
+        join_live=any(x.join_live for x in f),
+        drop_live=any(x.drop_live for x in f),
+    )
+
+
+def plan_segments(cfg: SimConfig, length: int, start_tick: int | None,
+                  grid_ticks: int) -> list[Segment]:
+    """Launch-aligned segment plan for ticks
+    ``[start_tick, start_tick + length)``.
+
+    ``start_tick=None`` means the caller cannot pin the run's absolute
+    start tick at trace time; the plan degenerates to one all-live
+    segment (bit-identical to the unsegmented kernel at any clock).
+    Launch boundaries are exactly the unsegmented ones (whole
+    ``grid_ticks`` chunks from the start, remainder last), so the
+    segmented orchestration hands the double-buffered HBM plane across
+    boundaries it was already crossing.
+    """
+    if length <= 0:
+        return []
+    if start_tick is None:
+        return [Segment(start=-1, ticks=length, flags=ALL_LIVE)]
+    win = phase_windows(cfg)
+    segs: list[Segment] = []
+    t = start_tick
+    remaining = length
+    while remaining > 0:
+        s_ticks = min(grid_ticks, remaining)
+        flags = _launch_flags(win, t, s_ticks)
+        if segs and segs[-1].flags == flags \
+                and segs[-1].ticks % grid_ticks == 0:
+            segs[-1] = dataclasses.replace(
+                segs[-1], ticks=segs[-1].ticks + s_ticks)
+        else:
+            segs.append(Segment(start=t, ticks=s_ticks, flags=flags))
+        t += s_ticks
+        remaining -= s_ticks
+    # planner invariant the kernel relies on: a join-dead launch has
+    # no starting events — the ramp is over and, when rejoin is
+    # enabled at all (finite rejoin_hi), the rejoin window is too
+    for seg in segs:
+        assert seg.flags.join_live or not (
+            seg.flags.ramp_live
+            or (seg.flags.churn_live and win.rejoin_hi < _INF)), seg
+    return segs
+
+
+def describe_plan(plan: list[Segment]) -> str:
+    """Compact human-readable plan, e.g.
+    ``"ramp+join:48 + churn+join:144 + steady:96"``."""
+    return " + ".join(f"{s.flags.tag}:{s.ticks}" for s in plan)

@@ -1,0 +1,27 @@
+"""Pallas TPU kernels + version-compat shims.
+
+JAX renamed the Pallas TPU compiler-params dataclass across releases:
+older releases (including the 0.4.x line installed here) spell it
+``pltpu.TPUCompilerParams``; newer ones spell it
+``pltpu.CompilerParams``.  Every kernel in this package goes through
+:func:`tpu_compiler_params` so both spellings work unmodified.
+"""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+#: the installed JAX's Pallas TPU compiler-params class (new spelling
+#: preferred, old spelling accepted)
+TPUCompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams(**kwargs)`` on any supported JAX.
+
+    All call sites pass keyword arguments only, and the fields used
+    here (``vmem_limit_bytes``, ``dimension_semantics``) exist under
+    both spellings.
+    """
+    return TPUCompilerParams(**kwargs)

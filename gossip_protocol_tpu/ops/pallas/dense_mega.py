@@ -50,6 +50,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import tpu_compiler_params
+
 #: dense ticks per launch (halved above 512 peers: the (S, N, N) drop
 #: stack and the ~12 live (N, N) temporaries share the same VMEM)
 DENSE_MEGA_TICKS = 16
@@ -343,7 +345,7 @@ def dense_mega_ticks(known, hb, ts, gossip, aux, gdrop, qdrop, pdrop,
                    jax.ShapeDtypeStruct((s_ticks, n), i32),
                    jax.ShapeDtypeStruct((s_ticks, n), i32)]
         + ev_shapes,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=110 * 1024 * 1024),
         interpret=interpret,
     )(sp, known, hb, ts, gossip, aux, gdrop, qdrop, pdrop)

@@ -56,6 +56,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...compat.jaxapi import shape_dtype_struct
+
 #: per-row metric counters packed into the ts output's spare lanes
 #: [K, K+N_COUNTERS): recv, removals, false_removals, victim_slots,
 #: adds, view_slots
@@ -364,9 +366,9 @@ def fused_overlay_tick(idsaux, pw, intro, masks, scalars, *,
                           churn_lo, churn_span, int(NEVER)),
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((n, k), i32, vma=frozenset(vma)),
-            jax.ShapeDtypeStruct((n, k), i32, vma=frozenset(vma)),
-            jax.ShapeDtypeStruct((n, 2 * k), i32, vma=frozenset(vma)),
+            shape_dtype_struct((n, k), i32, vma=vma),
+            shape_dtype_struct((n, k), i32, vma=vma),
+            shape_dtype_struct((n, 2 * k), i32, vma=vma),
         ],
         interpret=interpret,
     )(sp, idsaux, pw, *[aux_rounds[fi] for fi in range(f_rounds)],

@@ -64,6 +64,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import tpu_compiler_params
+
 from .overlay_mega import (MET_ADDS, MET_FALSE_REMOVALS,  # noqa: F401
                            MET_IN_GROUP, MET_RECV, MET_REMOVALS, MET_SENT,
                            MET_VICTIM, MET_VIEW, _lex, _sum_all, _umax0)
@@ -147,6 +149,8 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
             t_remove: int, churn_lo: int,
             churn_span: int, never: int, can_rejoin: bool,
             churn_mode: bool, powerlaw: bool,
+            ramp_live: bool, churn_live: bool, join_live: bool,
+            drop_live: bool,
             sp_ref, init_in, plane_out, met_out, *refs):
     from ...config import INTRODUCER
     from ...models.overlay import (ID_BITS, ID_MASK, SLOT_EPOCH,
@@ -248,32 +252,45 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
     part_scrs = [part_banks[fi].at[e_par] for fi in range(f_rounds)]
 
     # ---- tick-boundary revolves (first block of each tick) ---------
-    @pl.when((i == 0) & (s == 0))
-    def _():
-        # boot rows [N, N+8): row N the introducer broadcast row, row
-        # N+1 the JOINREQ aggregate (ANY-space input, so DMA through
-        # the bc scratch; the store semaphore is idle here)
-        cp = pltpu.make_async_copy(init_in.at[pl.ds(n, 8), :], bc_cur,
-                                   st_sems.at[0])
-        cp.start()
-        cp.wait()
-        q_cur[0:1, :] = bc_cur[1:2, 0:k]
+    # the join scratch (broadcast row + JOINREQ aggregate) only
+    # revolves while join machinery is live this launch
+    if join_live:
+        @pl.when((i == 0) & (s == 0))
+        def _():
+            # boot rows [N, N+8): row N the introducer broadcast row,
+            # row N+1 the JOINREQ aggregate (ANY-space input, so DMA
+            # through the bc scratch; the store semaphore is idle here)
+            cp = pltpu.make_async_copy(init_in.at[pl.ds(n, 8), :],
+                                       bc_cur, st_sems.at[0])
+            cp.start()
+            cp.wait()
+            q_cur[0:1, :] = bc_cur[1:2, 0:k]
 
-    @pl.when((i == 0) & (s > 0))
-    def _():
-        bc_cur[0:1, :] = bc_nxt[0:1, :]
-        q_cur[0:1, :] = q_nxt[0:1, :]
+        @pl.when((i == 0) & (s > 0))
+        def _():
+            bc_cur[0:1, :] = bc_nxt[0:1, :]
+            q_cur[0:1, :] = q_nxt[0:1, :]
+
+        @pl.when(i == 0)
+        def _():
+            q_nxt[0:1, :] = jnp.zeros((1, k), i32)
 
     @pl.when(i == 0)
     def _():
-        q_nxt[0:1, :] = jnp.zeros((1, k), i32)
         met_out[pl.ds(s, 1), :] = jnp.zeros((1, 128), i32)
 
     # ---- introducer gates + schedule helpers -----------------------
+    # ``wipe``: a rejoin can fire at a tick of THIS launch (static);
+    # churn_live=False guarantees failed/rejoining are identically
+    # False for every row, the introducer included
+    wipe = can_rejoin and churn_live
     fail0 = sp_ref[_GSP_FAIL0]
     rejoin0 = sp_ref[_GSP_REJOIN0]
-    failed0 = (t > fail0) & (t <= rejoin0)
-    proc0 = (t > 0) & jnp.logical_not(failed0)
+    if churn_live:
+        failed0 = (t > fail0) & (t <= rejoin0)
+        proc0 = (t > 0) & jnp.logical_not(failed0)
+    else:
+        proc0 = t > 0
     slot_ep = (t // SLOT_EPOCH).astype(jnp.uint32)
 
     def sched_of(subj):
@@ -309,34 +326,54 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
     ids0 = raw[:, 0:k]
     pw0, own_hb0, a1, _ = unpack_aux_lanes(raw[:, k:w])
     in_group0 = (a1 & 0x10) > 0
-    joinreq0 = (a1 & 0x20) > 0
-    joinrep0 = (a1 & 0x40) > 0
+    if join_live:
+        joinreq0 = (a1 & 0x20) > 0
+        joinrep0 = (a1 & 0x40) > 0
 
-    fail, rejoin = sched_of(rows)
-    failed = (t > fail) & (t <= rejoin)
-    # division-free start ramp (see module docstring); num/den ride
-    # the sp vector so the runtime sched argument is honored like
-    # every other schedule field
-    step_num = sp_ref[_GSP_STEP_NUM]
-    step_den = sp_ref[_GSP_STEP_DEN]
-    ramp = rows * step_num
-    t_gt_start = ramp < t * step_den
-    at_start = (ramp >= t * step_den) & (ramp < (t + 1) * step_den)
-    proc = t_gt_start & ~failed
-    if can_rejoin:                            # churn wipe (own rows)
+    # ``proc`` as an optional: None means "statically all-processing"
+    # (ramp over, nobody failed) — downstream gates vanish instead of
+    # AND-ing an all-true vector through the hot loop
+    if churn_live:
+        fail, rejoin = sched_of(rows)
+        failed = (t > fail) & (t <= rejoin)
+    if ramp_live:
+        # division-free start ramp (see module docstring); num/den
+        # ride the sp vector so the runtime sched argument is honored
+        # like every other schedule field
+        step_num = sp_ref[_GSP_STEP_NUM]
+        step_den = sp_ref[_GSP_STEP_DEN]
+        ramp = rows * step_num
+        t_gt_start = ramp < t * step_den
+        at_start = (ramp >= t * step_den) & (ramp < (t + 1) * step_den)
+        proc = t_gt_start & ~failed if churn_live else t_gt_start
+    else:
+        proc = jnp.logical_not(failed) if churn_live else None
+    if wipe:                                  # churn wipe (own rows)
         rejoining = t == rejoin
         ids0 = jnp.where(rejoining, -1, ids0)
         pw0 = jnp.where(rejoining, 0, pw0)
         in_group0 = in_group0 & ~rejoining
         own_hb0 = jnp.where(rejoining, 0, own_hb0)
-    else:
-        rejoining = jnp.zeros_like(is_intro)
 
-    jrep = joinrep0 & proc
-    in_group = in_group0 | jrep
-    starting = at_start | rejoining
-    in_group = in_group | (starting & is_intro)
-    ops = proc & in_group
+    # ``starting`` as an optional: None means "no start/rejoin event
+    # can fire this launch" (join_live=False implies None — planner
+    # invariant)
+    if ramp_live and wipe:
+        starting = at_start | rejoining
+    elif ramp_live:
+        starting = at_start
+    elif wipe:
+        starting = rejoining
+    else:
+        starting = None
+
+    in_group = in_group0
+    if join_live:
+        jrep = joinrep0 & proc if proc is not None else joinrep0
+        in_group = in_group | jrep
+    if starting is not None:
+        in_group = in_group | (starting & is_intro)
+    ops = proc & in_group if proc is not None else in_group
     own_hb = own_hb0 + ops.astype(i32)
 
     # ---- merge accumulator init ------------------------------------
@@ -371,14 +408,14 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
         in_ids = wv[:, 0:k]
         in_p, own_p, _, pa2 = unpack_aux_lanes(wv[:, k:w])
         partner = rows ^ m
-        if can_rejoin:                       # wipe-on-load (partner)
+        if wipe:                             # wipe-on-load (partner)
             _, prejoin = sched_of(partner)
             prj = t == prejoin
             in_ids = jnp.where(prj, -1, in_ids)
             in_p = jnp.where(prj, 0, in_p)
             own_p = jnp.where(prj, 0, own_p)
         flag = ((pa2 >> fi) & 1) > 0
-        ok = flag & proc
+        ok = flag & proc if proc is not None else flag
         valid = ok & (in_ids >= 0) & (in_p >= fresh_floor) \
             & (in_ids != rows)
         key = jnp.where(valid,
@@ -402,58 +439,62 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
     # rows and the JOINREQ aggregate only lands in the introducer's
     # block — so the accumulator revolves through scratch and the ~30
     # vector ops run under pl.when instead of burning every step.
-    jrep_any = _sum_all(jrep)[0, 0] > 0
-    acc_k[:] = kmax.astype(i32)
-    acc_p[:] = pacc
+    # With join machinery statically dead this launch, the whole block
+    # (and the accumulator's scratch round-trip) disappears.
+    if join_live:
+        jrep_any = _sum_all(jrep)[0, 0] > 0
+        acc_k[:] = kmax.astype(i32)
+        acc_p[:] = pacc
 
-    @pl.when(jrep_any)
-    def _():
-        kmax = acc_k[:].astype(jnp.uint32)
-        pacc = acc_p[:]
-        bcrow = bc_cur[0:1, :]
-        bc_ids = bcrow[:, 0:k]
-        bc_pw, bc_hb, _, _ = unpack_aux_lanes(bcrow[:, k:w])
-        if can_rejoin:                       # wipe-on-load (introducer)
-            rejoining0 = t == rejoin0
-            bc_ids = jnp.where(rejoining0, -1, bc_ids)
-            bc_pw = jnp.where(rejoining0, 0, bc_pw)
-            bc_hb = jnp.where(rejoining0, 0, bc_hb)
-        j_valid = jrep & (bc_ids >= 0) & (bc_pw >= fresh_floor) \
-            & (bc_ids != rows)
-        jkey = jnp.where(j_valid,
-                         ((bc_pw >> 12).astype(jnp.uint32) << ID_BITS)
-                         | bc_ids.astype(jnp.uint32),
-                         jnp.uint32(0))
-        kmax, pacc = _lex(kmax, pacc, jkey, jnp.where(j_valid, bc_pw, 0))
-        if t_remove > 1:                     # the introducer's self-entry
-            intro_vec = jnp.zeros_like(rows) + INTRODUCER
-            islot = _slot_of(seed, slot_ep, intro_vec, k)
-            iok = jrep & ~is_intro
-            ikey = jnp.where(iok, key_t1 | jnp.uint32(INTRODUCER),
+        @pl.when(jrep_any)
+        def _():
+            kmax = acc_k[:].astype(jnp.uint32)
+            pacc = acc_p[:]
+            bcrow = bc_cur[0:1, :]
+            bc_ids = bcrow[:, 0:k]
+            bc_pw, bc_hb, _, _ = unpack_aux_lanes(bcrow[:, k:w])
+            if wipe:                         # wipe-on-load (introducer)
+                rejoining0 = t == rejoin0
+                bc_ids = jnp.where(rejoining0, -1, bc_ids)
+                bc_pw = jnp.where(rejoining0, 0, bc_pw)
+                bc_hb = jnp.where(rejoining0, 0, bc_hb)
+            j_valid = jrep & (bc_ids >= 0) & (bc_pw >= fresh_floor) \
+                & (bc_ids != rows)
+            jkey = jnp.where(j_valid,
+                             ((bc_pw >> 12).astype(jnp.uint32) << ID_BITS)
+                             | bc_ids.astype(jnp.uint32),
                              jnp.uint32(0))
-            ip = jnp.where(iok, pw_t1 | (bc_hb + 1), 0)
-            imatch = islot == kk
-            kmax, pacc = _lex(kmax, pacc,
-                              jnp.where(imatch, ikey, jnp.uint32(0)),
-                              jnp.where(imatch, ip, 0))
-        acc_k[:] = kmax.astype(i32)
-        acc_p[:] = pacc
+            kmax, pacc = _lex(kmax, pacc, jkey,
+                              jnp.where(j_valid, bc_pw, 0))
+            if t_remove > 1:                 # the introducer's self-entry
+                intro_vec = jnp.zeros_like(rows) + INTRODUCER
+                islot = _slot_of(seed, slot_ep, intro_vec, k)
+                iok = jrep & ~is_intro
+                ikey = jnp.where(iok, key_t1 | jnp.uint32(INTRODUCER),
+                                 jnp.uint32(0))
+                ip = jnp.where(iok, pw_t1 | (bc_hb + 1), 0)
+                imatch = islot == kk
+                kmax, pacc = _lex(kmax, pacc,
+                                  jnp.where(imatch, ikey, jnp.uint32(0)),
+                                  jnp.where(imatch, ip, 0))
+            acc_k[:] = kmax.astype(i32)
+            acc_p[:] = pacc
 
-    @pl.when(i == INTRODUCER // b)
-    def _():
+        @pl.when(i == INTRODUCER // b)
+        def _():
+            kmax = acc_k[:].astype(jnp.uint32)
+            pacc = acc_p[:]
+            q_kf = q_cur[0:1, :].astype(jnp.uint32)
+            q_pf = jnp.where(q_kf > 0, _pack_th(t, 1), 0)
+            kmax, pacc = _lex(kmax, pacc,
+                              jnp.where(is_intro, q_kf, jnp.uint32(0)),
+                              jnp.where(is_intro, q_pf, 0))
+            acc_k[:] = kmax.astype(i32)
+            acc_p[:] = pacc
+
         kmax = acc_k[:].astype(jnp.uint32)
         pacc = acc_p[:]
-        q_kf = q_cur[0:1, :].astype(jnp.uint32)
-        q_pf = jnp.where(q_kf > 0, _pack_th(t, 1), 0)
-        kmax, pacc = _lex(kmax, pacc,
-                          jnp.where(is_intro, q_kf, jnp.uint32(0)),
-                          jnp.where(is_intro, q_pf, 0))
-        acc_k[:] = kmax.astype(i32)
-        acc_p[:] = pacc
-
-    kmax = acc_k[:].astype(jnp.uint32)
-    pacc = acc_p[:]
-    jreq = joinreq0 & proc0
+        jreq = joinreq0 & proc0
 
     # ---- winner extraction + staleness detection -------------------
     # the key IS (ts+1, id) and pacc IS the winner's packed pw word,
@@ -470,17 +511,22 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
     ids2 = jnp.where(stale, -1, ids1)
     pw2 = jnp.where(stale | ~occ1, 0, pacc)
 
-    # subject fail/rejoin for the accuracy metrics
-    subj = jnp.where(ids1 >= 0, ids1, 0)
-    s_fail, s_rejoin = sched_of(subj)
-    subj_failed = (t > s_fail) & (t <= s_rejoin)
+    if churn_live:
+        # subject fail/rejoin for the accuracy metrics
+        subj = jnp.where(ids1 >= 0, ids1, 0)
+        s_fail, s_rejoin = sched_of(subj)
+        subj_failed = (t > s_fail) & (t <= s_rejoin)
 
     # ---- dissemination: next tick's flags --------------------------
-    active = (sp_ref[_GSP_DROP_ON] > 0) & (t > sp_ref[_GSP_DROP_OPEN]) \
-        & (t <= sp_ref[_GSP_DROP_CLOSE])
-    gdrop = mix32(seed, tu, rows_u, fis.astype(jnp.uint32),
-                  np.uint32(_SALT_GOSSIP_DROP)) < drop_thr
-    sf_next = ops & ~(active & gdrop)
+    if drop_live:
+        active = (sp_ref[_GSP_DROP_ON] > 0) \
+            & (t > sp_ref[_GSP_DROP_OPEN]) \
+            & (t <= sp_ref[_GSP_DROP_CLOSE])
+        gdrop = mix32(seed, tu, rows_u, fis.astype(jnp.uint32),
+                      np.uint32(_SALT_GOSSIP_DROP)) < drop_thr
+        sf_next = ops & ~(active & gdrop)
+    else:
+        sf_next = jnp.broadcast_to(ops, (b, f_rounds))
     if powerlaw:
         du = mix32(seed, rows_u, np.uint32(_SALT_DEGREE))
         thr_hits = jnp.zeros((b, 1), i32)
@@ -490,49 +536,88 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
             ).astype(i32)
         deg = 1 + thr_hits
         sf_next = sf_next & (fis < deg)
-    joinreq_new = starting & ~is_intro
-    qdrop = mix32(seed, tu, rows_u, np.uint32(_SALT_JOINREQ_DROP)) \
-        < drop_thr
-    pdrop = mix32(seed, tu, rows_u, np.uint32(_SALT_JOINREP_DROP)) \
-        < drop_thr
-    joinreq_sent = joinreq_new & ~(active & qdrop)
-    joinrep_sent = jreq & ~(active & pdrop)
-    live_hold = ~proc & ~failed
-    joinreq_next = joinreq_sent \
-        | (joinreq0 & jnp.logical_not(proc0) & jnp.logical_not(failed0))
-    joinrep_next = joinrep_sent | (joinrep0 & live_hold)
+    if join_live:
+        if starting is not None:
+            joinreq_new = starting & ~is_intro
+            if drop_live:
+                qdrop = mix32(seed, tu, rows_u,
+                              np.uint32(_SALT_JOINREQ_DROP)) < drop_thr
+                joinreq_sent = joinreq_new & ~(active & qdrop)
+            else:
+                joinreq_sent = joinreq_new
+        else:
+            joinreq_sent = None              # statically no new joins
+        if drop_live:
+            pdrop = mix32(seed, tu, rows_u,
+                          np.uint32(_SALT_JOINREP_DROP)) < drop_thr
+            joinrep_sent = jreq & ~(active & pdrop)
+        else:
+            joinrep_sent = jreq
+        # in-flight holds: live_hold is statically False once the ramp
+        # is over and nobody is failed (proc is None)
+        hold_q = joinreq0 & jnp.logical_not(proc0)
+        if churn_live:
+            hold_q = hold_q & jnp.logical_not(failed0)
+        joinreq_next = hold_q if joinreq_sent is None \
+            else joinreq_sent | hold_q
+        if proc is None:
+            joinrep_next = joinrep_sent
+        else:
+            live_hold = ~proc & ~failed if churn_live else ~proc
+            joinrep_next = joinrep_sent | (joinrep0 & live_hold)
 
     # ---- metrics (pre-re-slot table, like the XLA path) ------------
+    removals_cnt = _sum_all(stale)
+    sent_cnt = _sum_all(sf_next)
+    recv_cnt = _sum_all(recv)
+    if join_live:
+        if joinreq_sent is not None:
+            sent_cnt = sent_cnt + _sum_all(joinreq_sent)
+        sent_cnt = sent_cnt + _sum_all(joinrep_sent)
+        recv_cnt = recv_cnt + _sum_all(jrep) + _sum_all(jreq)
+    if churn_live:
+        false_rem_cnt = _sum_all(stale & ~subj_failed)
+        victim_cnt = _sum_all((ids2 >= 0) & subj_failed & ~stale)
+    else:
+        # no subject can be inside its fail window this launch
+        false_rem_cnt = removals_cnt
+        victim_cnt = jnp.zeros((1, 1), i32)
     delta = jnp.concatenate([
         _sum_all(in_group),
         _sum_all(ids2 >= 0),
         _sum_all((ids1 != ids0) & (ids1 >= 0)),
-        _sum_all(stale),
-        _sum_all(stale & ~subj_failed),
-        _sum_all((ids2 >= 0) & subj_failed & ~stale),
-        _sum_all(sf_next) + _sum_all(joinreq_sent)
-        + _sum_all(joinrep_sent),
-        _sum_all(recv) + _sum_all(jrep) + _sum_all(jreq),
+        removals_cnt,
+        false_rem_cnt,
+        victim_cnt,
+        sent_cnt,
+        recv_cnt,
     ], axis=1)
     met_out[pl.ds(s, 1), 0:8] = met_out[pl.ds(s, 1), 0:8] + delta
 
     # ---- tick s+1's JOINREQ aggregate (cross-block scratch) --------
+    # the lookahead only matters for ticks whose successor is inside
+    # this launch (the host recomputes the boot aggregate at every
+    # launch boundary), so a join-dead launch skips it entirely
     t1 = t + 1
-    failed0_1 = (t1 > fail0) & (t1 <= rejoin0)
-    proc0_1 = (t1 > 0) & jnp.logical_not(failed0_1)
     slot_ep1 = (t1 // SLOT_EPOCH).astype(jnp.uint32)
-    jq1 = joinreq_next & proc0_1 & ~is_intro
-    qslot1 = _slot_of(seed, slot_ep1, rows, k)
-    qkey1 = jnp.where(jq1, _pack_key(rows, jnp.zeros_like(rows) + t1),
-                      jnp.uint32(0))
-    cand = jnp.where(qslot1 == kk, qkey1, jnp.uint32(0))
-    blkmax = _umax0(cand).astype(i32)              # (1, K) key bits
-    q_nxt[0:1, :] = _umax_i32(q_nxt[0:1, :], blkmax)
+    if join_live:
+        if churn_live:
+            failed0_1 = (t1 > fail0) & (t1 <= rejoin0)
+            proc0_1 = (t1 > 0) & jnp.logical_not(failed0_1)
+        else:
+            proc0_1 = t1 > 0
+        jq1 = joinreq_next & proc0_1 & ~is_intro
+        qslot1 = _slot_of(seed, slot_ep1, rows, k)
+        qkey1 = jnp.where(jq1, _pack_key(rows, jnp.zeros_like(rows) + t1),
+                          jnp.uint32(0))
+        cand = jnp.where(qslot1 == kk, qkey1, jnp.uint32(0))
+        blkmax = _umax0(cand).astype(i32)          # (1, K) key bits
+        q_nxt[0:1, :] = _umax_i32(q_nxt[0:1, :], blkmax)
 
     # ---- pack + stage the new block in scratch ---------------------
     pw_out = pack_aux_lanes(pw2, own_hb, in_group.astype(i32),
-                            joinreq_next.astype(i32),
-                            joinrep_next.astype(i32),
+                            joinreq_next.astype(i32) if join_live else 0,
+                            joinrep_next.astype(i32) if join_live else 0,
                             (sf_next.astype(i32)
                              << fis).sum(1, keepdims=True))
     pad = [jnp.zeros((b, PLANE_W - w), i32)] if w < PLANE_W else []
@@ -580,9 +665,10 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
                                    r_sf)] + pad, axis=1)
 
     # ---- publish tick s+1's introducer broadcast row ---------------
-    @pl.when(i == INTRODUCER // b)
-    def _():
-        bc_nxt[0:1, :] = own_scr[INTRODUCER % b:INTRODUCER % b + 1, :]
+    if join_live:
+        @pl.when(i == INTRODUCER // b)
+        def _():
+            bc_nxt[0:1, :] = own_scr[INTRODUCER % b:INTRODUCER % b + 1, :]
 
     # ---- DMA out: commit the block to the next phase ---------------
     # deferred: the wait happens when this bank's scratch is next
@@ -603,14 +689,22 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
     jax.jit, static_argnames=("n", "k", "f_rounds", "s_ticks", "b",
                               "t_remove",
                               "churn_lo", "churn_span", "can_rejoin",
-                              "churn_mode", "powerlaw", "interpret"))
+                              "churn_mode", "powerlaw", "ramp_live",
+                              "churn_live", "join_live", "drop_live",
+                              "interpret"))
 def grid_overlay_ticks(init, sp, *, n: int, k: int, f_rounds: int,
                        s_ticks: int, b: int, t_remove: int,
                        churn_lo: int,
                        churn_span: int, can_rejoin: bool,
                        churn_mode: bool, powerlaw: bool,
+                       ramp_live: bool = True, churn_live: bool = True,
+                       join_live: bool = True, drop_live: bool = True,
                        interpret: bool | None = None):
     """Run ``s_ticks`` whole overlay ticks in one grid-scale launch.
+
+    The four ``*_live`` flags are static phase-elision switches (see
+    models/segments.py for their exact OFF guarantees); with all four
+    on the kernel is the unsegmented original, valid at any clock.
 
     Args:
       init: i32[N + 8, PLANE_W] — rows [0, N) the packed state plane
@@ -632,6 +726,9 @@ def grid_overlay_ticks(init, sp, *, n: int, k: int, f_rounds: int,
         (init.shape, k)
     assert n % b == 0 and b & (b - 1) == 0 and 8 <= b, (n, b)
     assert f_rounds <= 8
+    # the kernel's join_live=False form assumes no start/rejoin event
+    # can fire this launch (models/segments.py planner invariant)
+    assert join_live or not (ramp_live or (can_rejoin and churn_live))
     from ...config import INTRODUCER
     from ...state import NEVER
     assert INTRODUCER < b, "introducer must live in row block 0"
@@ -657,11 +754,12 @@ def grid_overlay_ticks(init, sp, *, n: int, k: int, f_rounds: int,
     plane2, met = pl.pallas_call(
         functools.partial(_kernel, n, k, f_rounds, s_ticks, b, t_remove,
                           churn_lo, churn_span,
-                          int(NEVER), can_rejoin, churn_mode, powerlaw),
+                          int(NEVER), can_rejoin, churn_mode, powerlaw,
+                          ramp_live, churn_live, join_live, drop_live),
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct((2, n, PLANE_W), i32),
                    jax.ShapeDtypeStruct((s_ticks, 128), i32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(sp, init)

@@ -62,6 +62,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import tpu_compiler_params
+
 #: protocol ticks per launch (the launch-overhead amortization factor).
 #: One slot epoch per launch keeps at most one re-slot pass per chunk.
 MEGA_TICKS = 16
@@ -494,7 +496,7 @@ def mega_overlay_ticks(st, sp, *, n: int, k: int, f_rounds: int,
                    jax.ShapeDtypeStruct((s_ticks, 128), jnp.int32)],
         # the whole-state-resident design needs more than the default
         # 16 MB scoped window; v5e has 128 MB of physical VMEM
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(sp, st)

@@ -40,6 +40,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import tpu_compiler_params
+
 
 def _kernel(t_remove: int, tr: int, n: int, with_events: bool,
             # inputs
@@ -181,7 +183,7 @@ def fused_tick_update(m_all, m_fresh, t_fresh, recv_from,
         # ~17 double-buffered (TR, N) planes exceed the default 16 MB
         # scoped window at N=4096 (the old n<=2048 envelope); v5e has
         # 128 MB of physical VMEM
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=96 * 1024 * 1024),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),                # scalars

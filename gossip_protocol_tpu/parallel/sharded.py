@@ -91,7 +91,8 @@ def make_sharded_run(cfg: SimConfig, mesh: Mesh, block_size: int = 128,
             return carry, ev
         return jax.lax.scan(step, state, None, length=cfg.total_ticks)
 
-    shmapped = jax.shard_map(
+    from ..compat.jaxapi import shard_map
+    shmapped = shard_map(
         body, mesh=mesh,
         in_specs=(state_specs, _sched_specs()),
         out_specs=(state_specs, ev_specs),

@@ -16,6 +16,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from gossip_protocol_tpu.ops.pallas import tpu_compiler_params
+
 sys.path.insert(0, ".")
 
 
@@ -67,7 +69,7 @@ def probe(d, v, *, interpret: bool):
         out_shape=[jax.ShapeDtypeStruct((n, n), jnp.int32),
                    jax.ShapeDtypeStruct((n, n), jnp.int32)],
         scratch_shapes=[pltpu.VMEM((8, n), jnp.int32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(d, v)

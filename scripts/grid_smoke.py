@@ -1,16 +1,26 @@
-"""Hardware smoke + parity check for the grid-scale multi-tick kernel.
+"""Hardware smoke + parity + segment-timing probes for the grid-scale
+multi-tick kernel.
 
-Two phases, run as separate processes (the TPU relay latches the
-backend per process):
+Modes (run as separate processes — the TPU relay latches the backend
+per process); positional args are [n] [ticks] [block] [fanout] [scen]:
 
-    python scripts/grid_smoke.py run [n] [ticks]    # default backend
-    python scripts/grid_smoke.py check [n] [ticks]  # CPU, XLA path
+    python scripts/grid_smoke.py run 8192 96        # default backend
+    python scripts/grid_smoke.py check 8192 96      # CPU, XLA path
+    python scripts/grid_smoke.py seg 65536 608      # per-segment timing
+    python scripts/grid_smoke.py sweep 65536 192    # block x grid_ticks
 
-``run`` executes the grid kernel (compiled on TPU when available) and
-dumps the final state + metrics to /tmp/grid_smoke_<n>.npz; ``check``
-replays the same config through the per-tick XLA formulation on CPU
-and compares bit-for-bit.  This is the on-hardware counterpart of
-tests/test_overlay_grid.py (which runs interpret mode only).
+``run`` executes the grid kernel (compiled on TPU when available,
+routed through the segment planner) and dumps the final state +
+metrics to /tmp/grid_smoke_<n>.npz; ``check`` replays the same config
+through the per-tick XLA formulation on CPU and compares bit-for-bit
+— the on-hardware counterpart of tests/test_overlay_grid.py and
+tests/test_segments.py (which run interpret mode only).
+
+``seg`` prints the schedule-segment plan (models/segments.py) and
+times each segment's kernel variant separately — the per-segment
+op-savings breakdown for docs/PERF.md.  ``sweep`` times the segmented
+run over a block-rows x GRID_TICKS grid so the win is measured per
+config rather than assumed from the default launch shape.
 """
 
 import sys
@@ -37,6 +47,79 @@ def _cfg(n, ticks, fanout=0, mode="churn"):
                      step_rate=(ticks / 6.0) / n)
 
 
+def _seg_probe(cfg, sched, state, ticks, block):
+    """Time each schedule segment's specialized kernel variant.
+
+    Warmup compiles every variant and collects the (seed-11) state at
+    each segment boundary; timed reps replay each segment from its
+    boundary state under fresh seeds (the relay memoizes identical
+    (executable, args) calls) with an in-timing readback."""
+    import jax
+
+    from gossip_protocol_tpu.models.overlay import make_overlay_schedule
+    from gossip_protocol_tpu.models.overlay_grid import make_grid_run
+    from gossip_protocol_tpu.models.segments import (describe_plan,
+                                                     plan_segments)
+    from gossip_protocol_tpu.ops.pallas.overlay_grid import GRID_TICKS
+
+    plan = plan_segments(cfg, ticks, 0, GRID_TICKS)
+    print(f"backend={jax.default_backend()} n={cfg.n} ticks={ticks} "
+          f"block={block}\nplan: {describe_plan(plan)}", flush=True)
+    runs, states = [], []
+    st = state
+    for seg in plan:                     # compile + boundary states
+        run = make_grid_run(cfg, seg.ticks, block_rows=block,
+                            start_tick=seg.start)
+        states.append(st)
+        runs.append(run)
+        st, _ = run(st, sched)
+        jax.block_until_ready(st.ids)
+    for rep in (1, 2):
+        sched_r = make_overlay_schedule(cfg.replace(seed=cfg.seed + rep))
+        print(f"-- rep {rep}", flush=True)
+        for seg, run, st0 in zip(plan, runs, states):
+            t0 = time.perf_counter()
+            fin, _ = run(st0, sched_r)
+            readback = int(np.asarray(fin.ids[:1, :1])[0, 0])
+            wall = time.perf_counter() - t0
+            print(f"  {seg.flags.tag:>20} [{seg.start:4d},"
+                  f"{seg.start + seg.ticks:4d}): {wall:7.3f}s = "
+                  f"{seg.ticks / wall:8.1f} t/s "
+                  f"({cfg.n * seg.ticks / wall / 1e6:8.2f}M nt/s) "
+                  f"[readback {readback}]", flush=True)
+
+
+def _sweep(cfg, sched, state, ticks):
+    """Whole-run timing over a block-rows x grid_ticks grid."""
+    import jax
+
+    from gossip_protocol_tpu.models.overlay import make_overlay_schedule
+    from gossip_protocol_tpu.models.overlay_grid import make_grid_run
+
+    blocks = [b for b in (256, 512, 1024) if b <= cfg.n] or [cfg.n]
+    gts = [8, 16, 32]
+    print(f"backend={jax.default_backend()} n={cfg.n} ticks={ticks}",
+          flush=True)
+    for b in blocks:
+        for g in gts:
+            run = make_grid_run(cfg, ticks, block_rows=b, start_tick=0,
+                                grid_ticks=g)
+            fin, _ = run(state, sched)              # compile + warm
+            jax.block_until_ready(fin.ids)
+            best = float("inf")
+            for rep in (1, 2):
+                sched_r = make_overlay_schedule(
+                    cfg.replace(seed=cfg.seed + rep))
+                t0 = time.perf_counter()
+                fin, _ = run(state, sched_r)
+                int(np.asarray(fin.ids[:1, :1])[0, 0])   # readback
+                best = min(best, time.perf_counter() - t0)
+            print(f"  block={b:5d} grid_ticks={g:3d}: "
+                  f"{ticks / best:8.1f} t/s "
+                  f"({cfg.n * ticks / best / 1e6:8.2f}M nt/s)",
+                  flush=True)
+
+
 def main():
     mode = sys.argv[1] if len(sys.argv) > 1 else "run"
     n = int(sys.argv[2]) if len(sys.argv) > 2 else 8192
@@ -58,11 +141,18 @@ def main():
     sched = make_overlay_schedule(cfg)
     state = init_overlay_state(cfg)
 
+    if mode == "seg":
+        _seg_probe(cfg, sched, state, ticks, block)
+        return
+    if mode == "sweep":
+        _sweep(cfg, sched, state, ticks)
+        return
+
     if mode == "run":
         from gossip_protocol_tpu.models.overlay_grid import make_grid_run
         print(f"backend={jax.default_backend()} n={n} ticks={ticks} "
               f"block={block}", flush=True)
-        run = make_grid_run(cfg, ticks, block_rows=block)
+        run = make_grid_run(cfg, ticks, block_rows=block, start_tick=0)
         t0 = time.perf_counter()
         final, met = run(state, sched)
         jax.block_until_ready(final)

@@ -109,3 +109,54 @@ def test_random_config_paths_agree(trial):
         _assert_states(fa, fc, "corner", cfg)
         np.testing.assert_array_equal(np.asarray(sa), np.asarray(ec.sent))
         np.testing.assert_array_equal(np.asarray(ra), np.asarray(ec.recv))
+
+
+@pytest.mark.parametrize("drop", [False, True])
+def test_corner_riding_megakernel_interpret(drop):
+    """Corner+mega differential: the path the N=4096 bench actually
+    takes on TPU (make_corner_run routing launches through the dense
+    megakernel), forced in interpret mode at small N so a kernel
+    change that breaks corner+mega parity trips in CI rather than
+    only on hardware (ADVICE round 5, item 4).  Both sides draw the
+    width-A drop stream (tick_drop_masks at the corner width)."""
+    kw = dict(max_nnb=256, total_ticks=30, single_failure=True,
+              fail_tick=15, seed=21, drop_msg=False)
+    if drop:
+        kw.update(drop_msg=True, msg_drop_prob=0.25, drop_open_tick=4,
+                  drop_close_tick=26)
+    cfg = SimConfig(**kw)
+    a = active_bound(cfg)
+    assert 0 < a < cfg.n and dense_mega_supported(cfg.replace(max_nnb=a))
+    sched, state = make_schedule(cfg), init_state(cfg)
+    run_a = _scan_run(
+        make_tick(cfg, use_pallas=False, with_events=False, n_active=a),
+        cfg.total_ticks)
+    fa, (sa, ra) = run_a(state, sched)
+    fc, ec = make_corner_run(cfg, a, use_pallas=True,
+                             force_mega=True)(state, sched)
+    _assert_states(fa, fc, "corner+mega", cfg)
+    np.testing.assert_array_equal(np.asarray(sa), np.asarray(ec.sent))
+    np.testing.assert_array_equal(np.asarray(ra), np.asarray(ec.recv))
+
+
+def test_corner_run_rejects_nonzero_start_tick():
+    """active_bound spans the whole-run horizon, so the corner run
+    refuses a resumed (tick != 0) state (ADVICE round 5, item 1)."""
+    cfg = SimConfig(max_nnb=256, total_ticks=30, single_failure=True,
+                    fail_tick=15, seed=3, drop_msg=False)
+    a = active_bound(cfg)
+    sched, state = make_schedule(cfg), init_state(cfg)
+    run = make_corner_run(cfg, a, use_pallas=False)
+    mid, _ = run(state, sched)      # tick-0 start: fine
+    with pytest.raises(ValueError, match="tick-0"):
+        run(mid, sched)
+
+
+def test_active_bound_negative_step_rate_falls_back_full_width():
+    """A pathological negative step_rate breaks the bisection's
+    monotonicity precondition; the bound must fall back to N instead
+    of miscomputing a corner (ADVICE round 5, item 2)."""
+    cfg = SimConfig(max_nnb=256, total_ticks=30, single_failure=True,
+                    fail_tick=15, seed=3, drop_msg=False)
+    assert 0 < active_bound(cfg) < cfg.n
+    assert active_bound(cfg.replace(step_rate=-0.25)) == cfg.n

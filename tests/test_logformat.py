@@ -6,6 +6,7 @@ import os
 import re
 
 import numpy as np
+import pytest
 
 from gossip_protocol_tpu.core.sim import Simulation
 from gossip_protocol_tpu.events import LogEvent
@@ -88,11 +89,15 @@ def test_msgcount_format():
 def test_msgcount_against_reference_shape(tmp_path):
     """Our msgcount.log for N=10/700 ticks must be line-structurally
     identical to the committed reference artifact."""
+    ref_path = "/root/reference/msgcount.log"
+    if not os.path.exists(ref_path):
+        pytest.skip("reference C++ run artifact not present in this "
+                    "image (external to the repo)")
     cfg = scenario_cfg("singlefailure", seed=0)
     res = Simulation(cfg).run()
     write_msgcount_log(res.sent, res.recv, str(tmp_path))
     ours = (tmp_path / "msgcount.log").read_text().split("\n")
-    ref = open("/root/reference/msgcount.log").read().split("\n")
+    ref = open(ref_path).read().split("\n")
     assert len(ours) == len(ref)
     for a, b in zip(ours, ref):
         # same structure: collapse each padded number, compare skeletons

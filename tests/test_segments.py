@@ -1,0 +1,168 @@
+"""Schedule-segmented grid kernel: planner + differential tests.
+
+The segment planner (models/segments.py) splits a run at the
+closed-form schedule boundaries and compiles a specialized grid-kernel
+variant per segment (static ``ramp_live``/``churn_live``/``join_live``
+/``drop_live`` elision in ops/pallas/overlay_grid.py).  The parity bar
+is absolute: the segmented run must replay the exact trajectory of the
+per-tick XLA formulation — final state bit-identical, per-tick metrics
+identical except ``live_uncovered`` (the grid path's -1 sentinel).
+Interpret mode on CPU; the same contract holds compiled on TPU
+(bench.py routes its grid configs through the planner).
+"""
+
+import numpy as np
+import pytest
+
+from gossip_protocol_tpu.config import SimConfig
+from gossip_protocol_tpu.models.overlay import (init_overlay_state,
+                                                make_overlay_run,
+                                                make_overlay_schedule)
+from gossip_protocol_tpu.models.overlay_grid import make_grid_run
+from gossip_protocol_tpu.models.segments import (ALL_LIVE, PhaseFlags,
+                                                 describe_plan, flags_at,
+                                                 phase_windows,
+                                                 plan_segments)
+from gossip_protocol_tpu.ops.pallas.overlay_grid import GRID_TICKS
+
+STATE_FIELDS = ("tick", "ids", "hb", "ts", "in_group", "own_hb",
+                "send_flags", "joinreq", "joinrep")
+METRIC_FIELDS = ("in_group", "view_slots", "adds", "removals",
+                 "false_removals", "victim_slots", "sent", "recv")
+
+#: small row block so n=64 runs as multiple grid blocks
+BLOCK = 32
+
+
+def _cfg(scenario, n=64):
+    if scenario == "churn":
+        return SimConfig(max_nnb=n, model="overlay", single_failure=False,
+                         drop_msg=False, seed=7, total_ticks=200,
+                         churn_rate=0.25, rejoin_after=30,
+                         step_rate=40.0 / n)
+    if scenario == "fail_rejoin":
+        return SimConfig(max_nnb=n, model="overlay", single_failure=False,
+                         drop_msg=False, seed=3, total_ticks=180,
+                         fail_tick=70, rejoin_after=25, step_rate=0.5)
+    if scenario == "drop10":
+        # the BASELINE 10%-drop shape in miniature: ramp finishes, the
+        # window opens at 20 and closes at 90, a scripted failure with
+        # no rejoin keeps churn_live on for the run's tail
+        return SimConfig(max_nnb=n, model="overlay", single_failure=True,
+                         drop_msg=True, msg_drop_prob=0.1, seed=5,
+                         total_ticks=160, fail_tick=60, step_rate=0.25,
+                         drop_open_tick=20, drop_close_tick=90)
+    raise ValueError(scenario)
+
+
+def _compare(cfg, length, start_tick=0, state=None):
+    sched = make_overlay_schedule(cfg)
+    if state is None:
+        state = init_overlay_state(cfg)
+    run_x = make_overlay_run(cfg, length, use_pallas=False)
+    run_g = make_grid_run(cfg, length, block_rows=BLOCK,
+                          start_tick=start_tick)
+    fx, mx = run_x(state, sched)
+    fg, mg = run_g(state, sched)
+    for name in STATE_FIELDS:
+        a, b = np.asarray(getattr(fx, name)), np.asarray(getattr(fg, name))
+        assert np.array_equal(a, b), f"state field {name} diverged"
+    for name in METRIC_FIELDS:
+        a, b = np.asarray(getattr(mx, name)), np.asarray(getattr(mg, name))
+        assert np.array_equal(a, b), \
+            f"metric {name} diverged at ticks {np.flatnonzero(a != b)[:5]}"
+    return fg
+
+
+@pytest.mark.parametrize("scenario", ["churn", "fail_rejoin", "drop10"])
+def test_segmented_run_bitwise_equals_xla(scenario):
+    cfg = _cfg(scenario)
+    plan = plan_segments(cfg, cfg.total_ticks, 0, GRID_TICKS)
+    # the plan must actually specialize (several variants), or the
+    # test would only re-prove the all-live kernel
+    assert len(plan) >= 2, describe_plan(plan)
+    assert len({s.flags for s in plan}) >= 2, describe_plan(plan)
+    _compare(cfg, cfg.total_ticks)
+
+
+def test_segmented_steady_state_elides_everything():
+    """A churn run's tail is the fully-dead steady-state variant."""
+    cfg = _cfg("churn")
+    plan = plan_segments(cfg, cfg.total_ticks, 0, GRID_TICKS)
+    assert plan[-1].flags == PhaseFlags(False, False, False, False), \
+        describe_plan(plan)
+
+
+def test_segmented_resume_from_pinned_tick():
+    """A segmented continuation pinned to tick 48 (the post-ramp
+    clock) replays the uninterrupted trajectory bit-identically."""
+    cfg = _cfg("churn")
+    sched = make_overlay_schedule(cfg)
+    state = init_overlay_state(cfg)
+    mid, _ = make_overlay_run(cfg, 48, use_pallas=False)(state, sched)
+    final = _compare(cfg, cfg.total_ticks - 48, start_tick=48, state=mid)
+    assert int(np.asarray(final.tick)) == cfg.total_ticks
+
+
+def test_segmented_run_rejects_mismatched_clock():
+    cfg = _cfg("churn")
+    sched = make_overlay_schedule(cfg)
+    state = init_overlay_state(cfg)
+    mid, _ = make_overlay_run(cfg, 16, use_pallas=False)(state, sched)
+    run = make_grid_run(cfg, 32, block_rows=BLOCK, start_tick=0)
+    with pytest.raises(ValueError, match="start tick"):
+        run(mid, sched)
+
+
+def test_planner_windows_and_flags():
+    cfg = _cfg("drop10")                    # n=64, step 1/4, no rejoin
+    win = phase_windows(cfg)
+    assert win.last_start == 63 // 4 == 15
+    assert win.join_dead_from == 15 + 3     # no rejoin: ramp-only joins
+    assert win.drop_lo == 21 and win.drop_hi == 90
+    assert flags_at(win, 15).ramp_live and not flags_at(win, 16).ramp_live
+    assert not flags_at(win, 20).drop_live
+    assert flags_at(win, 21).drop_live and flags_at(win, 90).drop_live
+    assert not flags_at(win, 91).drop_live
+    # scripted failure without rejoin: victims stay failed forever
+    # (the window is conservative by one tick at the fail boundary)
+    assert not flags_at(win, 59).churn_live
+    assert flags_at(win, 61).churn_live and flags_at(win, 10_000).churn_live
+
+    churn = _cfg("churn")                   # total=200 -> fails [50,149]
+    cwin = phase_windows(churn)
+    assert cwin.fail_lo == 50 and cwin.rejoin_hi == 149 + 30
+    assert cwin.join_dead_from == 179 + 3
+    assert not flags_at(cwin, 182).join_live
+    assert flags_at(cwin, 185) == PhaseFlags(False, False, False, False)
+
+
+def test_planner_launch_alignment_and_coverage():
+    cfg = _cfg("churn")
+    for length, t0 in ((200, 0), (152, 48), (44, 0), (17, 100)):
+        plan = plan_segments(cfg, length, t0, GRID_TICKS)
+        assert sum(s.ticks for s in plan) == length
+        t = t0
+        for j, seg in enumerate(plan):
+            assert seg.start == t
+            t += seg.ticks
+            if j < len(plan) - 1:           # only the tail may be ragged
+                assert seg.ticks % GRID_TICKS == 0
+        # consecutive segments always change flags (maximal merging)
+        for a, b in zip(plan, plan[1:]):
+            assert a.flags != b.flags or a.ticks % GRID_TICKS != 0
+
+
+def test_planner_unpinned_clock_degenerates_to_all_live():
+    cfg = _cfg("churn")
+    plan = plan_segments(cfg, 200, None, GRID_TICKS)
+    assert len(plan) == 1 and plan[0].flags == ALL_LIVE
+    assert plan_segments(cfg, 0, 0, GRID_TICKS) == []
+
+
+def test_planner_is_seed_independent():
+    cfg = _cfg("churn")
+    plans = {describe_plan(plan_segments(cfg.replace(seed=s), 200, 0,
+                                         GRID_TICKS))
+             for s in (0, 1, 2, 99)}
+    assert len(plans) == 1
