@@ -380,6 +380,41 @@ def bench_overlay(n: int, ticks: int, mode: str = "churn",
     return best
 
 
+def bench_overlay_fleet(n: int, ticks: int, batch: int = 8):
+    """Fleet-batched overlay churn bench: ``batch`` seeds through ONE
+    compiled program (core/fleet.py) — the dispatch-amortization
+    counterpart of :func:`bench_overlay`'s sequential runs.  Validated
+    per lane like the sequential bench: every lane must finish fully
+    joined with its victims purged (coverage is host-checkable on lane
+    states; the fleet reports the grid/mega kernels' -1 sentinel for
+    the per-tick histogram)."""
+    import numpy as np
+
+    from gossip_protocol_tpu.config import SimConfig
+    from gossip_protocol_tpu.core.fleet import FleetSimulation
+
+    cfg = SimConfig(max_nnb=n, model="overlay", single_failure=False,
+                    drop_msg=False, seed=0, total_ticks=ticks,
+                    churn_rate=0.2, rejoin_after=40, step_rate=64.0 / n)
+    fleet = FleetSimulation(cfg)
+    fleet.run_bench(seeds=range(101, 101 + batch), warmup=False)  # compile
+    best = None
+    for rep in range(2):
+        # distinct seed sets per rep (relay memoization, see
+        # bench_overlay)
+        seeds = [1000 * (rep + 1) + i for i in range(batch)]
+        res = fleet.run_bench(seeds=seeds, warmup=False)
+        if best is None or res.wall_seconds < best.wall_seconds:
+            best = res
+    for lane in best.lanes:
+        m = lane.metrics
+        if int(np.asarray(m.in_group)[-1]) != n:
+            raise RuntimeError("fleet bench: join/rejoin incomplete")
+        if int(np.asarray(m.victim_slots)[-1]) != 0:
+            raise RuntimeError("fleet bench: victims not purged")
+    return best
+
+
 def bench_dense(n: int, ticks: int):
     from gossip_protocol_tpu.config import SimConfig
     from gossip_protocol_tpu.core.sim import Simulation
@@ -439,7 +474,30 @@ def main():
     drop = bench_overlay(n_drop, max(t_overlay, 200), mode="drop")
     dense_cfg, dense = bench_dense(n_dense, t_dense)
 
-    secondary = {
+    secondary = {}
+    if backend == "cpu":
+        # fleet-batched serving shape (core/fleet.py): B seeds of the
+        # headline config through one compiled program.  CPU-only for
+        # now: the TPU fleet rides the batched grid kernel, whose
+        # hardware timing recipe lives in docs/PERF.md §8.
+        fb = 4 if smoke else 8
+        fleet = bench_overlay_fleet(n_overlay, t_overlay, fb)
+        agg = fleet.aggregate_node_ticks_per_second
+        secondary[f"fleet{fb}_n{n_overlay}_overlay_churn20"] = {
+            "batch": fb,
+            "aggregate_node_ticks_per_s": round(agg, 1),
+            "per_run_node_ticks_per_s": round(
+                fleet.node_ticks_per_second_per_run, 1),
+            # the dispatch-amortization win: one fleet program vs B
+            # sequential runs at the sequential bench's own rate
+            "speedup_vs_sequential": round(
+                agg / overlay.node_ticks_per_second, 2),
+            "vs_baseline": round(agg / REFERENCE_NODE_TICKS_PER_S, 3),
+        }
+        secondary[f"fleet{fb}_aggregate_node_ticks_per_s_"
+                  f"n{n_overlay}_overlay_churn20"] = round(agg, 1)
+
+    secondary.update({
         f"n{n_drop}_overlay_drop10": _overlay_entry(drop, backend),
         f"n{n_dense}_fullview": _entry(dense_cfg, dense, backend),
         # continuity keys for round-over-round comparison
@@ -449,7 +507,7 @@ def main():
             drop.node_ticks_per_second / REFERENCE_NODE_TICKS_PER_S, 3),
         f"node_ticks_per_s_n{n_dense}_fullview": round(dense, 1),
         "fullview_vs_baseline": round(dense / REFERENCE_NODE_TICKS_PER_S, 3),
-    }
+    })
     if backend == "tpu" and not smoke:
         # the (4096, 65536] envelope: the grid multi-tick kernel's
         # smallest headline size (was the unrecorded fallback gap)

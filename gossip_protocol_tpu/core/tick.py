@@ -341,6 +341,17 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
 #: through the Schedule arrays, so reuse is sound.
 _RUN_CACHE: dict = {}
 
+#: how many run functions have been BUILT (cache misses).  A second
+#: ``Simulation.run_bench(seed=...)`` must not move this counter — the
+#: cache key is config shape only, seeds flow through the Schedule
+#: arrays (regression: tests/test_fleet.py::test_run_bench_no_rebuild).
+_BUILD_COUNT = 0
+
+
+def run_build_count() -> int:
+    """Number of whole-run functions built so far (cache misses)."""
+    return _BUILD_COUNT
+
 
 def make_run(cfg: SimConfig, block_size: int = 128, with_events: bool = True,
              use_pallas: bool | None = None):
@@ -350,6 +361,7 @@ def make_run(cfg: SimConfig, block_size: int = 128, with_events: bool = True,
     With ``with_events=False`` only the send/recv counters are stacked
     (benchmark mode — avoids materializing T*(N,N) masks).
     """
+    global _BUILD_COUNT
     comm = LocalComm(use_pallas)
     from .dense_corner import active_bound, make_corner_run
     from .dense_mega import dense_mega_supported, make_dense_mega_run
@@ -364,6 +376,7 @@ def make_run(cfg: SimConfig, block_size: int = 128, with_events: bool = True,
            a if corner else cfg.n)
     if key in _RUN_CACHE:
         return _RUN_CACHE[key]
+    _BUILD_COUNT += 1
     if corner:
         # bench mode at a config whose schedule never starts peers
         # >= A: run on the static active corner (dense_corner.py) —

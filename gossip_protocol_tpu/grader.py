@@ -166,6 +166,45 @@ def _default_runner(conf: str, workdir: str) -> None:
     run_scenario(SimConfig.from_conf(conf), outdir=workdir)
 
 
+#: the three shipped scenarios, in Grader.sh order
+SCENARIOS = ("singlefailure", "multifailure", "msgdropsinglefailure")
+
+
+def grade_all_fleet(testcases_dir: str = "testcases",
+                    workdir: str = ".") -> dict:
+    """Grade the three shipped scenarios from ONE fleet run.
+
+    The scenarios share a compiled shape (N=10, 700 ticks; their
+    single/multi/drop differences are all Schedule data), so instead
+    of three sequential trace runs they execute as a B=3
+    :class:`~.core.fleet.FleetSimulation` — one vmapped program, one
+    dispatch per chunk for all three course scenarios.  Per-lane
+    events are bit-identical to the sequential runs
+    (tests/test_fleet.py), so the grades are too; mirrors
+    :func:`grade_all`'s totals exactly.
+    """
+    from .config import SimConfig
+    from .core.fleet import FleetSimulation
+
+    cfgs = [SimConfig.from_conf(os.path.join(testcases_dir, f"{s}.conf"))
+            for s in SCENARIOS]
+    fleet = FleetSimulation(cfgs[0]).run(configs=cfgs)
+    dbg = os.path.join(workdir, "dbg.log")
+    results = {}
+    for name, lane in zip(SCENARIOS, fleet.lanes):
+        lane.write_logs(workdir)
+        if name == "singlefailure":
+            results[name] = grade_single(dbg)
+        elif name == "multifailure":
+            results[name] = grade_multi(dbg)
+        else:
+            results[name] = grade_single(dbg, join_pts=15, comp_pts=15,
+                                         acc_pts=None)
+    results["total"] = sum(r.points for r in results.values()
+                           if isinstance(r, ScenarioGrade))
+    return results
+
+
 def main(argv=None) -> int:
     import argparse
     ap = argparse.ArgumentParser(description="Grade the three scenarios "
@@ -197,7 +236,9 @@ def main(argv=None) -> int:
     if args.platform:
         import jax
         jax.config.update("jax_platforms", args.platform)
-    results = grade_all(_default_runner, args.testcases, args.workdir)
+    # the three course scenarios run as a single B=3 fleet (one
+    # compiled program, one dispatch per chunk for all three)
+    results = grade_all_fleet(args.testcases, args.workdir)
     for name, g in results.items():
         if isinstance(g, ScenarioGrade):
             print(f"{name}: join {g.join_points}/{g.join_max}  "

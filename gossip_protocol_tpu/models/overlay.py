@@ -93,6 +93,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -441,7 +442,8 @@ class LocalOverlayComm:
 
 
 def make_overlay_tick(cfg: SimConfig, comm=None,
-                      use_pallas: bool | None = None):
+                      use_pallas: bool | None = None,
+                      with_coverage: bool | None = None):
     """Build ``tick(state, sched) -> (state', OverlayMetrics)``.
 
     With the default :class:`LocalOverlayComm` this is a single-device
@@ -460,6 +462,14 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
     (tests/test_overlay_pallas.py, tests/test_overlay_sharded.py) and
     measured faster on v5e (per tick: ~3.4ms vs ~4.3ms at 65k, ~57ms
     vs ~106ms at 1M — scripts/profile_tick.py, 200-tick scans).
+
+    ``with_coverage`` overrides the per-tick ``live_uncovered``
+    histogram (None = auto: tracked for N <= COVERAGE_N_LIMIT).  The
+    fleet path passes False — the scatter behind the histogram
+    serializes badly under batching (it was ~40% of a CPU tick at
+    N=2048) — and reports the same -1 "not tracked" sentinel the mega
+    and grid kernels already use; coverage stays verifiable host-side
+    on the final state (:meth:`OverlayResult.final_coverage`).
     """
     comm = comm or LocalOverlayComm()
     if use_pallas is None:
@@ -490,7 +500,8 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
         "shard count must divide the peer count (both powers of two)"
     use_kernel = bool(use_pallas) and k >= N_COUNTERS and nl >= 8
     factors = _xor_factors(nl)
-    with_coverage = n <= COVERAGE_N_LIMIT
+    if with_coverage is None:
+        with_coverage = n <= COVERAGE_N_LIMIT
 
     rows = jnp.arange(n, dtype=jnp.int32)        # global, replicated
     intro_onehot = rows == INTRODUCER
@@ -1028,6 +1039,71 @@ def make_overlay_run(cfg: SimConfig, length: int | None = None,
     return run
 
 
+_OVERLAY_FLEET_CACHE: dict = {}
+
+
+def make_overlay_fleet_run(cfg: SimConfig, batch: int,
+                           length: int | None = None,
+                           use_pallas: bool | None = None):
+    """One compiled program over ``batch`` stacked overlay lanes.
+
+    ``run(states, scheds) -> (finals, OverlayMetrics[batch, length])``:
+    ``states`` is a stacked :class:`OverlayState` whose ``tick`` is a
+    SHARED scalar (every lane starts at the same clock and ticks in
+    lockstep — that keeps the SLOT_EPOCH re-slot ``lax.cond`` a real
+    cond under ``vmap`` instead of degrading to a both-branches
+    select), and ``scheds`` a stacked :class:`OverlaySchedule` (every
+    field batched; distinct seeds live here).
+
+    Routing (core/fleet.py is the orchestrator):
+
+    * TPU + grid-supported config: the batched grid kernel — an
+      explicit leading batch grid dimension
+      (:func:`~.overlay_grid.make_grid_fleet_run`), never
+      ``jax.vmap``-of-``pallas_call``.
+    * everywhere else: the XLA tick under ``jax.vmap`` inside one
+      jitted ``lax.scan`` with the stacked carry donated
+      (``donate_argnums``) — one dispatch per scan step for the whole
+      fleet.  Built with ``with_coverage=False``: per-tick
+      ``live_uncovered`` reports the -1 sentinel (exactly like the
+      mega/grid kernels; see :func:`make_overlay_tick`).
+
+    Per lane the trajectory is bit-identical to a sequential
+    :func:`make_overlay_run` of the lane's schedule
+    (tests/test_fleet.py); only the ``live_uncovered`` metric differs.
+    """
+    length = cfg.total_ticks if length is None else length
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    from .overlay_grid import grid_supported, make_grid_fleet_run
+    grid = (bool(use_pallas) and grid_supported(cfg)
+            and jax.default_backend() == "tpu")
+    key = (cfg.replace(seed=0), batch, length, grid)
+    if key in _OVERLAY_FLEET_CACHE:
+        return _OVERLAY_FLEET_CACHE[key]
+    if grid:
+        run = make_grid_fleet_run(cfg, length, batch, start_tick=0)
+        _OVERLAY_FLEET_CACHE[key] = run
+        return run
+    tick = make_overlay_tick(cfg, use_pallas=False, with_coverage=False)
+    state_axes = OverlayState(tick=None, ids=0, hb=0, ts=0, in_group=0,
+                              own_hb=0, send_flags=0, joinreq=0,
+                              joinrep=0)
+    vtick = jax.vmap(tick, in_axes=(state_axes, 0),
+                     out_axes=(state_axes, 0))
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def run(states: OverlayState, scheds: OverlaySchedule):
+        def step(carry, _):
+            return vtick(carry, scheds)
+        finals, mets = jax.lax.scan(step, states, None, length=length)
+        # scan stacks ticks leading: (T, B) -> the (B, T) fleet contract
+        return finals, jax.tree.map(lambda m: m.T, mets)
+
+    _OVERLAY_FLEET_CACHE[key] = run
+    return run
+
+
 def _overlay_expect(host):
     n, k = np.asarray(host["ids"]).shape
     f = np.asarray(host["send_flags"]).shape[1]
@@ -1074,6 +1150,11 @@ class OverlayResult:
 
     @property
     def node_ticks_per_second(self) -> float:
+        """Work rate; 0.0 for degenerate segments (same guard as
+        ``SimResult.ticks_per_second``: a zero-length resumed segment
+        pairs 0 ticks with a ~0 — possibly sub-resolution — wall)."""
+        if self.ticks_run == 0 or self.wall_seconds <= 0.0:
+            return 0.0
         return self.cfg.n * self.ticks_run / self.wall_seconds
 
     def uncovered_members(self):

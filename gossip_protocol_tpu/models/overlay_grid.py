@@ -62,6 +62,41 @@ def grid_supported(cfg: SimConfig) -> bool:
             and num * (n - 1) < 2 ** 31)
 
 
+def _grid_kern_kwargs(cfg: SimConfig, k: int, f: int, b: int) -> dict:
+    """The static kernel kwargs a config bakes in — ONE definition
+    shared by the single-lane and fleet harnesses, so the two can
+    never drift apart (their per-lane bit-parity is a test contract)."""
+    return dict(n=cfg.n, k=k, f_rounds=f, b=b, t_remove=cfg.t_remove,
+                churn_lo=cfg.total_ticks // 4,
+                churn_span=max(cfg.total_ticks // 2, 1),
+                can_rejoin=cfg.churn_rate > 0
+                or cfg.rejoin_after is not None,
+                churn_mode=cfg.churn_rate > 0,
+                powerlaw=cfg.topology == "powerlaw")
+
+
+def _clock_guard(start_tick: int | None, tick, what: str) -> None:
+    """Refuse a pinned segment plan at an unverifiable or wrong clock
+    (shared by the single-lane and fleet grid runs)."""
+    if start_tick is None:
+        return
+    if isinstance(tick, jax.core.Tracer):
+        # a pinned plan applied at an unverifiable clock would elide
+        # phases on the wrong absolute ticks — refuse rather than
+        # silently compute a bit-wrong trajectory
+        raise ValueError(
+            f"segmented {what} cannot verify its pinned start tick "
+            f"({start_tick}) under a traced state; call it outside "
+            "jit, or build with start_tick=None for the clock-agnostic "
+            "unsegmented variant")
+    if int(tick) != start_tick:
+        raise ValueError(
+            f"segmented {what} was planned for start tick {start_tick} "
+            f"but the state is at tick {int(tick)}; build the run with "
+            "the matching start_tick (or None for the unsegmented "
+            "variant)")
+
+
 def pack_grid_plane(cfg: SimConfig, state: OverlayState):
     """OverlayState -> the packed (N, PLANE_W) plane."""
     from ..ops.pallas.overlay_grid import PLANE_W
@@ -179,13 +214,7 @@ def make_grid_run(cfg: SimConfig, length: int,
     k, f = resolved_dims(cfg)
     b = min(block_rows, n)
     plan = plan_segments(cfg, length, start_tick, grid_ticks)
-    kern_kw = dict(n=n, k=k, f_rounds=f, b=b, t_remove=cfg.t_remove,
-                   churn_lo=cfg.total_ticks // 4,
-                   churn_span=max(cfg.total_ticks // 2, 1),
-                   can_rejoin=cfg.churn_rate > 0
-                   or cfg.rejoin_after is not None,
-                   churn_mode=cfg.churn_rate > 0,
-                   powerlaw=cfg.topology == "powerlaw")
+    kern_kw = _grid_kern_kwargs(cfg, k, f, b)
 
     def _metrics(met):
         return OverlayMetrics(
@@ -215,24 +244,7 @@ def make_grid_run(cfg: SimConfig, length: int,
         return unpack_grid_plane(cfg, plane, t), _metrics(met)
 
     def _check_clock(state: OverlayState):
-        if start_tick is None:
-            return
-        tick = state.tick
-        if isinstance(tick, jax.core.Tracer):
-            # a pinned plan applied at an unverifiable clock would
-            # elide phases on the wrong absolute ticks — refuse
-            # rather than silently compute a bit-wrong trajectory
-            raise ValueError(
-                "segmented grid run cannot verify its pinned start "
-                f"tick ({start_tick}) under a traced state; call it "
-                "outside jit, or build with start_tick=None for the "
-                "clock-agnostic unsegmented variant")
-        if int(tick) != start_tick:
-            raise ValueError(
-                f"segmented grid run was planned for start tick "
-                f"{start_tick} but the state is at tick {int(tick)}; "
-                "build the run with the matching start_tick (or None "
-                "for the unsegmented variant)")
+        _clock_guard(start_tick, state.tick, "grid run")
 
     def run_body(state: OverlayState, sched: OverlaySchedule):
         plane = pack_grid_plane(cfg, state)
@@ -285,5 +297,135 @@ def make_grid_run(cfg: SimConfig, length: int,
                 plane, t, met = launch(plane, t, sched, rem, seg.flags)
                 met_parts.append(met)
         return assemble(plane, t, met_parts)
+
+    return run_eager
+
+
+#: vmap axes for a stacked fleet state: every lane carries its own
+#: arrays but the CLOCK is shared (lanes tick in lockstep), so ``tick``
+#: stays an unbatched scalar
+FLEET_STATE_AXES = OverlayState(
+    tick=None, ids=0, hb=0, ts=0, in_group=0, own_hb=0,
+    send_flags=0, joinreq=0, joinrep=0)
+
+
+def make_grid_fleet_run(cfg: SimConfig, length: int, batch: int,
+                        block_rows: int = GRID_BLOCK_ROWS,
+                        start_tick: int | None = 0,
+                        grid_ticks: int = GRID_TICKS):
+    """Fleet-batched grid run: ONE kernel launch steps ``batch``
+    independent simulations (distinct seeds, same config shape) via the
+    leading batch grid dimension (ops/pallas/overlay_grid.py) — never
+    ``jax.vmap``-of-``pallas_call``, which would destroy the kernel's
+    manual DMA structure.
+
+    ``run(states, scheds) -> (finals, OverlayMetrics[batch, length])``
+    where ``states`` is a stacked :class:`OverlayState` (``tick`` a
+    shared scalar, arrays with a leading (B,) axis) and ``scheds`` a
+    stacked :class:`OverlaySchedule` (every field batched).  The
+    schedule-segment plan is shared by all lanes: plans are derived
+    from the config alone, never the seed (models/segments.py), so one
+    variant sequence serves the whole fleet.  Bit-identical per lane to
+    ``make_grid_run`` of the lane's schedule (tests/test_fleet.py)."""
+    from .segments import plan_segments
+    assert grid_supported(cfg), "config outside the grid-kernel envelope"
+    assert batch >= 1
+    n = cfg.n
+    k, f = resolved_dims(cfg)
+    b = min(block_rows, n)
+    plan = plan_segments(cfg, length, start_tick, grid_ticks)
+    kern_kw = _grid_kern_kwargs(cfg, k, f, b)
+
+    def _metrics(met):
+        return OverlayMetrics(
+            in_group=met[:, :, MET_IN_GROUP],
+            view_slots=met[:, :, MET_VIEW],
+            adds=met[:, :, MET_ADDS],
+            removals=met[:, :, MET_REMOVALS],
+            false_removals=met[:, :, MET_FALSE_REMOVALS],
+            victim_slots=met[:, :, MET_VICTIM],
+            live_uncovered=jnp.full((batch, length), -1, jnp.int32),
+            sent=met[:, :, MET_SENT],
+            recv=met[:, :, MET_RECV],
+        )
+
+    def launch(planes, t, scheds, s_ticks: int, flags):
+        boots = jax.vmap(
+            lambda sc, p: _boot_rows(cfg, sc, p, t))(scheds, planes)
+        init = jnp.concatenate([planes, boots], axis=1)
+        sp = jax.vmap(
+            lambda sc: _sp_vector(sc, t, s_ticks, n, f))(scheds)
+        plane2, met = grid_overlay_ticks(init, sp, s_ticks=s_ticks,
+                                         batch=batch, **kern_kw,
+                                         **flags.as_kernel_kwargs())
+        return plane2[:, s_ticks % 2], t + s_ticks, met
+
+    def assemble(planes, t, met_parts):
+        met = jnp.concatenate(met_parts, axis=1) if met_parts \
+            else jnp.zeros((batch, 0, 128), jnp.int32)
+        finals = jax.vmap(lambda p: unpack_grid_plane(cfg, p, t),
+                          out_axes=FLEET_STATE_AXES)(planes)
+        return finals, _metrics(met)
+
+    def _check_clock(states: OverlayState):
+        _clock_guard(start_tick, states.tick, "grid fleet run")
+
+    def _pack(states: OverlayState):
+        return jax.vmap(lambda st: pack_grid_plane(cfg, st),
+                        in_axes=(FLEET_STATE_AXES,))(states)
+
+    def run_body(states: OverlayState, scheds: OverlaySchedule):
+        planes = _pack(states)
+        t = states.tick
+        met_parts = []
+        for seg in plan:
+            n_chunks, rem = divmod(seg.ticks, grid_ticks)
+            if n_chunks:
+                def step(carry, _, _flags=seg.flags):
+                    planes, t, met = launch(carry[0], carry[1], scheds,
+                                            grid_ticks, _flags)
+                    return (planes, t), met
+                (planes, t), met_main = jax.lax.scan(
+                    step, (planes, t), None, length=n_chunks)
+                # (n_chunks, B, grid_ticks, 128) -> (B, ticks, 128)
+                met_parts.append(
+                    met_main.swapaxes(0, 1)
+                    .reshape(batch, n_chunks * grid_ticks, 128))
+            if rem:
+                planes, t, met_rem = launch(planes, t, scheds, rem,
+                                            seg.flags)
+                met_parts.append(met_rem)
+        return assemble(planes, t, met_parts)
+
+    if jax.default_backend() == "tpu":
+        run_tpu = jax.jit(run_body, donate_argnums=(0,),
+                          compiler_options={
+                              "xla_tpu_scoped_vmem_limit_kib": "98304"})
+
+        def run_checked(states, scheds):
+            _check_clock(states)
+            return run_tpu(states, scheds)
+
+        return run_checked
+
+    def run_eager(states, scheds):
+        # eager per-launch dispatch off-TPU, like make_grid_run's
+        # eager path: inlining interpret-mode kernels into a jitted
+        # scan blows up the XLA:CPU compile (overlay_mega.make_mega_run)
+        _check_clock(states)
+        planes = _pack(states)
+        t = states.tick
+        met_parts = []
+        for seg in plan:
+            n_chunks, rem = divmod(seg.ticks, grid_ticks)
+            for _ in range(n_chunks):
+                planes, t, met = launch(planes, t, scheds, grid_ticks,
+                                        seg.flags)
+                met_parts.append(met)
+            if rem:
+                planes, t, met = launch(planes, t, scheds, rem,
+                                        seg.flags)
+                met_parts.append(met)
+        return assemble(planes, t, met_parts)
 
     return run_eager

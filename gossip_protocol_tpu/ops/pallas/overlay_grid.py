@@ -52,6 +52,18 @@ Scope: single device, power-of-two N with 2K <= 128, N a multiple of
 the (power-of-two) row-block size, INTRODUCER in block 0, runs capped
 at 4094 ticks, and step_num*(N-1) < 2^31 (the division-free ramp
 comparisons must not overflow i32).
+
+**Fleet batching** (models/overlay_grid.make_grid_fleet_run): the grid
+carries a leading batch dimension — ``grid = (B, s_ticks, row
+blocks)`` — so ONE launch steps B independent simulations (distinct
+seeds, same config shape).  Each lane owns its slice of the
+double-buffered plane, its scalar-prefetch row (seeds differ, so the
+per-tick XOR masks differ per lane), and its metrics block; the
+revolving scratch banks are reused across lanes, which is safe because
+grid execution is sequential and every lane drains its deferred stores
+at its own final step.  This is the batch-native alternative to
+``jax.vmap``-of-``pallas_call`` (which would destroy the manual DMA
+structure) and amortizes the per-launch dispatch floor B ways.
 """
 
 from __future__ import annotations
@@ -169,16 +181,18 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
     w = 2 * k                # data lanes; the plane is padded to PLANE_W
     #                          (Mosaic DMA slices must be 128-aligned
     #                          along lanes)
-    s = pl.program_id(0)
-    i = pl.program_id(1)
-    t = sp_ref[_GSP_T0] + s
+    lane = pl.program_id(0)  # fleet lane (batch=1: always 0)
+    s = pl.program_id(1)
+    i = pl.program_id(2)
+    t = sp_ref[lane, _GSP_T0] + s
     tu = t.astype(jnp.uint32)
     phase = jax.lax.rem(s, 2)
-    seed = sp_ref[_GSP_SEED].astype(jnp.uint32)
-    churn_thr = sp_ref[_GSP_CTHR].astype(jnp.uint32)
-    drop_thr = sp_ref[_GSP_DROP_THR].astype(jnp.uint32)
+    seed = sp_ref[lane, _GSP_SEED].astype(jnp.uint32)
+    churn_thr = sp_ref[lane, _GSP_CTHR].astype(jnp.uint32)
+    drop_thr = sp_ref[lane, _GSP_DROP_THR].astype(jnp.uint32)
     ns = _GSP_NSCALARS + max(f_rounds - 1, 0)      # masks offset
-    masks = [sp_ref[ns + s * f_rounds + fi] for fi in range(f_rounds)]
+    masks = [sp_ref[lane, ns + s * f_rounds + fi]
+             for fi in range(f_rounds)]
 
     # ---- DMA in: banked prefetch ------------------------------------
     # Loads for step e = s*nb + i are issued one step AHEAD into bank
@@ -193,7 +207,7 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
 
     def issue_loads(s_e, i_e, bank):
         """Start the (1+F) block loads of step (s_e, i_e) into bank."""
-        masks_e = [sp_ref[ns + s_e * f_rounds + fi]
+        masks_e = [sp_ref[lane, ns + s_e * f_rounds + fi]
                    for fi in range(f_rounds)]
         phase_e = jax.lax.rem(s_e, 2)
         rows_e = [i_e * b] + [(i_e ^ (masks_e[fi] // b)) * b
@@ -203,26 +217,26 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
         for j, (row0, dst) in enumerate(zip(rows_e, dsts)):
             @pl.when(s_e == 0)
             def _(row0=row0, dst=dst, j=j):
-                pltpu.make_async_copy(init_in.at[pl.ds(row0, b), :],
+                pltpu.make_async_copy(init_in.at[lane, pl.ds(row0, b), :],
                                       dst, ld_sems.at[bank, j]).start()
 
             @pl.when(s_e > 0)
             def _(row0=row0, dst=dst, j=j):
                 pltpu.make_async_copy(
-                    plane_out.at[phase_e, pl.ds(row0, b), :],
+                    plane_out.at[lane, phase_e, pl.ds(row0, b), :],
                     dst, ld_sems.at[bank, j]).start()
 
     def wait_loads(bank):
         for j in range(1 + f_rounds):
             dst = own_bank.at[bank] if j == 0 \
                 else part_banks[j - 1].at[bank]
-            pltpu.make_async_copy(init_in.at[pl.ds(0, b), :], dst,
+            pltpu.make_async_copy(init_in.at[0, pl.ds(0, b), :], dst,
                                   ld_sems.at[bank, j]).wait()
 
     def wait_store(bank):
         pltpu.make_async_copy(
             own_bank.at[bank],
-            plane_out.at[0, pl.ds(0, b), :], st_sems.at[bank]).wait()
+            plane_out.at[0, 0, pl.ds(0, b), :], st_sems.at[bank]).wait()
 
     @pl.when((i == 0) & (s > 0))
     def _():
@@ -260,7 +274,7 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
             # boot rows [N, N+8): row N the introducer broadcast row,
             # row N+1 the JOINREQ aggregate (ANY-space input, so DMA
             # through the bc scratch; the store semaphore is idle here)
-            cp = pltpu.make_async_copy(init_in.at[pl.ds(n, 8), :],
+            cp = pltpu.make_async_copy(init_in.at[lane, pl.ds(n, 8), :],
                                        bc_cur, st_sems.at[0])
             cp.start()
             cp.wait()
@@ -277,15 +291,15 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
 
     @pl.when(i == 0)
     def _():
-        met_out[pl.ds(s, 1), :] = jnp.zeros((1, 128), i32)
+        met_out[0, pl.ds(s, 1), :] = jnp.zeros((1, 128), i32)
 
     # ---- introducer gates + schedule helpers -----------------------
     # ``wipe``: a rejoin can fire at a tick of THIS launch (static);
     # churn_live=False guarantees failed/rejoining are identically
     # False for every row, the introducer included
     wipe = can_rejoin and churn_live
-    fail0 = sp_ref[_GSP_FAIL0]
-    rejoin0 = sp_ref[_GSP_REJOIN0]
+    fail0 = sp_ref[lane, _GSP_FAIL0]
+    rejoin0 = sp_ref[lane, _GSP_REJOIN0]
     if churn_live:
         failed0 = (t > fail0) & (t <= rejoin0)
         proc0 = (t > 0) & jnp.logical_not(failed0)
@@ -305,12 +319,13 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
                 mix32(seed, subj_u, np.uint32(_SALT_CHURN_TICK))
                 % np.uint32(churn_span)).astype(i32)
             fail = jnp.where(churned, churn_fail, never)
-            after = sp_ref[_GSP_CAFTER]
+            after = sp_ref[lane, _GSP_CAFTER]
         else:
             fail = jnp.where(
-                (subj >= sp_ref[_GSP_VLO]) & (subj < sp_ref[_GSP_VHI]),
-                sp_ref[_GSP_FTICK], never)
-            after = sp_ref[_GSP_RAFTER]
+                (subj >= sp_ref[lane, _GSP_VLO])
+                & (subj < sp_ref[lane, _GSP_VHI]),
+                sp_ref[lane, _GSP_FTICK], never)
+            after = sp_ref[lane, _GSP_RAFTER]
         rejoin = jnp.where((fail != never) & (after != never),
                            fail + after, never)
         return fail, rejoin
@@ -340,8 +355,8 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
         # division-free start ramp (see module docstring); num/den
         # ride the sp vector so the runtime sched argument is honored
         # like every other schedule field
-        step_num = sp_ref[_GSP_STEP_NUM]
-        step_den = sp_ref[_GSP_STEP_DEN]
+        step_num = sp_ref[lane, _GSP_STEP_NUM]
+        step_den = sp_ref[lane, _GSP_STEP_DEN]
         ramp = rows * step_num
         t_gt_start = ramp < t * step_den
         at_start = (ramp >= t * step_den) & (ramp < (t + 1) * step_den)
@@ -519,9 +534,9 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
 
     # ---- dissemination: next tick's flags --------------------------
     if drop_live:
-        active = (sp_ref[_GSP_DROP_ON] > 0) \
-            & (t > sp_ref[_GSP_DROP_OPEN]) \
-            & (t <= sp_ref[_GSP_DROP_CLOSE])
+        active = (sp_ref[lane, _GSP_DROP_ON] > 0) \
+            & (t > sp_ref[lane, _GSP_DROP_OPEN]) \
+            & (t <= sp_ref[lane, _GSP_DROP_CLOSE])
         gdrop = mix32(seed, tu, rows_u, fis.astype(jnp.uint32),
                       np.uint32(_SALT_GOSSIP_DROP)) < drop_thr
         sf_next = ops & ~(active & gdrop)
@@ -532,7 +547,7 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
         thr_hits = jnp.zeros((b, 1), i32)
         for j in range(f_rounds - 1):
             thr_hits = thr_hits + (
-                du < sp_ref[_GSP_NSCALARS + j].astype(jnp.uint32)
+                du < sp_ref[lane, _GSP_NSCALARS + j].astype(jnp.uint32)
             ).astype(i32)
         deg = 1 + thr_hits
         sf_next = sf_next & (fis < deg)
@@ -592,7 +607,7 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
         sent_cnt,
         recv_cnt,
     ], axis=1)
-    met_out[pl.ds(s, 1), 0:8] = met_out[pl.ds(s, 1), 0:8] + delta
+    met_out[0, pl.ds(s, 1), 0:8] = met_out[0, pl.ds(s, 1), 0:8] + delta
 
     # ---- tick s+1's JOINREQ aggregate (cross-block scratch) --------
     # the lookahead only matters for ticks whose successor is inside
@@ -675,7 +690,7 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
     # reused (prefetch / tick-boundary drain), hiding the store
     # latency behind the following step's compute
     pltpu.make_async_copy(
-        own_scr, plane_out.at[1 - phase, pl.ds(i * b, b), :],
+        own_scr, plane_out.at[lane, 1 - phase, pl.ds(i * b, b), :],
         st_sems.at[e_par]).start()
 
     @pl.when((s == s_ticks - 1) & (i == nb - 1))
@@ -691,7 +706,7 @@ def _kernel(n: int, k: int, f_rounds: int, s_ticks: int, b: int,
                               "churn_lo", "churn_span", "can_rejoin",
                               "churn_mode", "powerlaw", "ramp_live",
                               "churn_live", "join_live", "drop_live",
-                              "interpret"))
+                              "batch", "interpret"))
 def grid_overlay_ticks(init, sp, *, n: int, k: int, f_rounds: int,
                        s_ticks: int, b: int, t_remove: int,
                        churn_lo: int,
@@ -699,6 +714,7 @@ def grid_overlay_ticks(init, sp, *, n: int, k: int, f_rounds: int,
                        churn_mode: bool, powerlaw: bool,
                        ramp_live: bool = True, churn_live: bool = True,
                        join_live: bool = True, drop_live: bool = True,
+                       batch: int = 1,
                        interpret: bool | None = None):
     """Run ``s_ticks`` whole overlay ticks in one grid-scale launch.
 
@@ -715,6 +731,11 @@ def grid_overlay_ticks(init, sp, *, n: int, k: int, f_rounds: int,
         (uint32 key bits as i32) for the start tick.
       sp: i32[NS + (F-1) + s_ticks*F] scalars, power-law degree
         thresholds, and the per-tick XOR masks.
+      batch: fleet width B (module docstring).  With ``batch > 1`` the
+        grid grows a leading lane dimension and every array gains a
+        leading B axis: init i32[B, N+8, PLANE_W], sp i32[B, NS...],
+        returns (plane2 i32[B, 2, N, PLANE_W], metrics
+        i32[B, s_ticks, 128]).  One launch steps every lane.
 
     Returns ``(plane2 i32[2, N, 2K], metrics i32[s_ticks, 128])`` —
     the end state is ``plane2[s_ticks % 2]``; metric columns per the
@@ -722,8 +743,14 @@ def grid_overlay_ticks(init, sp, *, n: int, k: int, f_rounds: int,
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    assert init.shape == (n + 8, PLANE_W) and 2 * k <= PLANE_W, \
-        (init.shape, k)
+    squeeze = init.ndim == 2
+    if squeeze:
+        assert batch == 1, (batch, init.shape)
+        init = init[None]
+        sp = sp[None]
+    assert init.shape == (batch, n + 8, PLANE_W) and 2 * k <= PLANE_W, \
+        (init.shape, batch, k)
+    assert sp.shape[0] == batch, (sp.shape, batch)
     assert n % b == 0 and b & (b - 1) == 0 and 8 <= b, (n, b)
     assert f_rounds <= 8
     # the kernel's join_live=False form assumes no start/rejoin event
@@ -736,11 +763,11 @@ def grid_overlay_ticks(init, sp, *, n: int, k: int, f_rounds: int,
     i32 = jnp.int32
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(s_ticks, nb),
+        grid=(batch, s_ticks, nb),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec((s_ticks, 128), lambda s, i, sp: (0, 0),
+            pl.BlockSpec((1, s_ticks, 128), lambda l, s, i, sp: (l, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
         scratch_shapes=[pltpu.VMEM((2, b, PLANE_W), i32)
@@ -757,10 +784,12 @@ def grid_overlay_ticks(init, sp, *, n: int, k: int, f_rounds: int,
                           int(NEVER), can_rejoin, churn_mode, powerlaw,
                           ramp_live, churn_live, join_live, drop_live),
         grid_spec=grid_spec,
-        out_shape=[jax.ShapeDtypeStruct((2, n, PLANE_W), i32),
-                   jax.ShapeDtypeStruct((s_ticks, 128), i32)],
+        out_shape=[jax.ShapeDtypeStruct((batch, 2, n, PLANE_W), i32),
+                   jax.ShapeDtypeStruct((batch, s_ticks, 128), i32)],
         compiler_params=tpu_compiler_params(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(sp, init)
+    if squeeze:
+        return plane2[0], met[0]
     return plane2, met

@@ -1,0 +1,201 @@
+"""Fleet timing + parity probes for fleet-batched execution
+(core/fleet.py) — the fleet counterpart of scripts/grid_smoke.py.
+
+Modes (positional args are [n] [ticks] [B]):
+
+    python scripts/fleet_smoke.py time 2048 288 8    # fleet vs sequential A/B
+    python scripts/fleet_smoke.py sweep 2048 288     # B in {1, 4, 8, 32}
+    python scripts/fleet_smoke.py parity 64 64 4     # bit-parity, all paths
+
+``time`` runs the overlay-churn bench config both ways — B sequential
+``OverlaySimulation`` runs, then the same B seeds as one
+``FleetSimulation`` — and prints the aggregate node-ticks/s of each
+plus the honest wall-clock speedup (the PR's acceptance measurement).
+``sweep`` produces the batch-scaling curve for docs/PERF.md §8.
+``parity`` replays the fleet test suite's checks at script scale:
+per-lane bit-equality for the dense bench fleet, the overlay XLA
+fleet, and the batched grid kernel (interpret mode off-TPU).
+
+Scripts need PYTHONPATH=/root/repo.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+
+def _cfg(n, ticks):
+    from gossip_protocol_tpu.config import SimConfig
+    return SimConfig(max_nnb=n, model="overlay", single_failure=False,
+                     drop_msg=False, seed=0, total_ticks=ticks,
+                     churn_rate=0.2, rejoin_after=40, step_rate=64.0 / n)
+
+
+def _sequential(cfg, seeds):
+    """B sequential runs (compile amortized before timing)."""
+    import jax
+
+    from gossip_protocol_tpu.models.overlay import OverlaySimulation
+    sim = OverlaySimulation(cfg, use_pallas=None)
+    sim.run()                                   # compile + warm
+    t0 = time.perf_counter()
+    for s in seeds:
+        OverlaySimulation(cfg.replace(seed=s)).run()
+    jax.block_until_ready(jax.numpy.zeros(()))
+    return time.perf_counter() - t0
+
+
+def _fleet(cfg, seeds, warm_seeds):
+    from gossip_protocol_tpu.core.fleet import FleetSimulation
+    fleet = FleetSimulation(cfg)
+    fleet.run_bench(seeds=warm_seeds, warmup=False)   # compile + warm
+    t0 = time.perf_counter()
+    res = fleet.run_bench(seeds=seeds, warmup=False)
+    return time.perf_counter() - t0, res
+
+
+def _time(n, ticks, batch):
+    """Three-way A/B so the speedup decomposes honestly: the fleet
+    tick also elides the per-tick coverage histogram (the −1 sentinel
+    mode, docs/PERF.md §8), so a B=1 fleet run IS the like-for-like
+    sequential baseline — same tick, no batching."""
+    import jax
+
+    from gossip_protocol_tpu.core.fleet import FleetSimulation
+    cfg = _cfg(n, ticks)
+    print(f"backend={jax.default_backend()} n={n} ticks={ticks} "
+          f"B={batch}", flush=True)
+    seeds = list(range(21, 21 + batch))
+    t_seq = _sequential(cfg, seeds)
+    agg_seq = batch * n * ticks / t_seq
+    print(f"sequential (shipped)   x{batch}: {t_seq:7.3f}s = "
+          f"{agg_seq / 1e3:8.1f}k aggregate node-ticks/s", flush=True)
+    fleet1 = FleetSimulation(cfg)
+    fleet1.run_bench(seeds=[121], warmup=False)       # compile + warm
+    t0 = time.perf_counter()
+    for s in seeds:
+        fleet1.run_bench(seeds=[s], warmup=False)
+    t_seq_nc = time.perf_counter() - t0
+    print(f"sequential (B=1 fleet) x{batch}: {t_seq_nc:7.3f}s = "
+          f"{batch * n * ticks / t_seq_nc / 1e3:8.1f}k aggregate "
+          "node-ticks/s", flush=True)
+    t_fleet, res = _fleet(cfg, seeds, list(range(121, 121 + batch)))
+    agg_fleet = res.total_node_ticks / t_fleet
+    print(f"fleet                  x{batch}: {t_fleet:7.3f}s = "
+          f"{agg_fleet / 1e3:8.1f}k aggregate node-ticks/s", flush=True)
+    print(f"speedup vs shipped sequential: {t_seq / t_fleet:.2f}x "
+          f"(= {t_seq / t_seq_nc:.2f}x coverage elision x "
+          f"{t_seq_nc / t_fleet:.2f}x batching)", flush=True)
+    return t_seq / t_fleet
+
+
+def _sweep(n, ticks):
+    import jax
+    cfg = _cfg(n, ticks)
+    print(f"backend={jax.default_backend()} n={n} ticks={ticks}",
+          flush=True)
+    t1 = _sequential(cfg, [21])
+    print(f"  B= 1 (sequential): {t1:7.3f}s = "
+          f"{n * ticks / t1 / 1e3:8.1f}k nt/s", flush=True)
+    for b in (4, 8, 32):
+        t_f, res = _fleet(cfg, list(range(21, 21 + b)),
+                          list(range(121, 121 + b)))
+        agg = res.total_node_ticks / t_f
+        print(f"  B={b:2d} (fleet):      {t_f:7.3f}s = "
+              f"{agg / 1e3:8.1f}k aggregate nt/s "
+              f"({agg / (n * ticks / t1):5.2f}x the B=1 rate)",
+              flush=True)
+
+
+def _parity(n, ticks, batch):
+    from gossip_protocol_tpu.config import SimConfig
+    from gossip_protocol_tpu.core.fleet import (FleetSimulation,
+                                                _lane_state,
+                                                _stack_states, stack_lanes)
+    from gossip_protocol_tpu.core.sim import Simulation
+    from gossip_protocol_tpu.models.overlay import (OverlaySimulation,
+                                                    init_overlay_state,
+                                                    make_overlay_schedule)
+    from gossip_protocol_tpu.models.overlay_grid import (
+        make_grid_fleet_run, make_grid_run)
+
+    bad = 0
+    seeds = list(range(1, 1 + batch))
+
+    def check(name, a, b):
+        nonlocal bad
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            print(f"MISMATCH {name}")
+            bad += 1
+
+    # overlay XLA fleet (parity runs at small n, where the bench
+    # config's step_rate would overlap the churn window — use the
+    # fast-ramp rate the fleet test suite uses)
+    cfg = _cfg(n, ticks).replace(step_rate=8.0 / n)
+    fleet = FleetSimulation(cfg).run(seeds=seeds)
+    for i, s in enumerate(seeds):
+        ref = OverlaySimulation(cfg.replace(seed=s), use_pallas=False).run()
+        lane = fleet.lanes[i]
+        for f in ("ids", "hb", "ts", "in_group", "send_flags"):
+            check(f"overlay lane {i} {f}", getattr(ref.final_state, f),
+                  getattr(lane.final_state, f))
+        for m in ("sent", "recv", "removals", "victim_slots"):
+            check(f"overlay lane {i} metric {m}", getattr(ref.metrics, m),
+                  getattr(lane.metrics, m))
+
+    # dense bench fleet
+    dcfg = SimConfig(max_nnb=min(n, 64), single_failure=False,
+                     drop_msg=True, msg_drop_prob=0.1, seed=0,
+                     total_ticks=min(ticks, 100), fail_tick=30,
+                     rejoin_after=20)
+    dfleet = FleetSimulation(dcfg).run_bench(seeds=seeds)
+    dsim = Simulation(dcfg)
+    for i, s in enumerate(seeds):
+        ref = dsim.run_bench(seed=s)
+        lane = dfleet.lanes[i]
+        check(f"dense lane {i} known", ref.final_state.known,
+              lane.final_state.known)
+        check(f"dense lane {i} sent", ref.sent, lane.sent)
+
+    # batched grid kernel (interpret off-TPU)
+    gcfgs = [cfg.replace(seed=s) for s in seeds[:2]]
+    scheds = [make_overlay_schedule(c) for c in gcfgs]
+    states = _stack_states([init_overlay_state(c) for c in gcfgs])
+    gt = min(ticks, 20)
+    run_f = make_grid_fleet_run(cfg, gt, 2, block_rows=min(n, 32),
+                                start_tick=0)
+    ff, mf = run_f(states, stack_lanes(scheds))
+    for i, c in enumerate(gcfgs):
+        f1, m1 = make_grid_run(c, gt, block_rows=min(n, 32),
+                               start_tick=0)(init_overlay_state(c),
+                                             scheds[i])
+        check(f"grid lane {i} ids", f1.ids, _lane_state(ff, i).ids)
+        check(f"grid lane {i} sent", m1.sent, np.asarray(mf.sent)[i])
+
+    print("PARITY OK" if not bad else f"PARITY FAILED ({bad} checks)")
+    sys.exit(1 if bad else 0)
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else "time"
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 2048
+    ticks = int(sys.argv[3]) if len(sys.argv) > 3 else 288
+    batch = int(sys.argv[4]) if len(sys.argv) > 4 else 8
+
+    if mode == "parity":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    if mode == "time":
+        _time(n, ticks, batch)
+    elif mode == "sweep":
+        _sweep(n, ticks)
+    elif mode == "parity":
+        _parity(n, ticks, batch)
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+
+
+if __name__ == "__main__":
+    main()

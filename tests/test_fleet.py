@@ -1,0 +1,227 @@
+"""Fleet-batched execution: per-lane bit-parity with sequential runs.
+
+One compiled program serves B simulations (core/fleet.py); these tests
+pin the contract that batching is EXACT: every lane of a fleet must be
+bit-identical to the same seed run alone — dense bench and trace
+modes, the overlay XLA path, and the batched grid kernel (interpret
+mode on CPU; the same leading-batch-grid-dimension kernel compiles on
+TPU).  Plus the satellite regressions: ``SimResult.ticks_per_second``
+degenerate-segment guard and the bench-path compile-cache keying.
+"""
+
+import numpy as np
+import pytest
+
+from gossip_protocol_tpu.config import SimConfig
+from gossip_protocol_tpu.core.fleet import (FleetSimulation, _lane_state,
+                                            _stack_states, stack_lanes)
+from gossip_protocol_tpu.core.sim import SimResult, Simulation
+
+STATE_FIELDS = ("tick", "in_group", "own_hb", "known", "hb", "ts",
+                "gossip", "joinreq", "joinrep")
+OV_STATE_FIELDS = ("tick", "ids", "hb", "ts", "in_group", "own_hb",
+                   "send_flags", "joinreq", "joinrep")
+OV_METRIC_FIELDS = ("in_group", "view_slots", "adds", "removals",
+                    "false_removals", "victim_slots", "sent", "recv")
+
+SEEDS = [1, 2, 3, 4]
+
+
+def _dense_churn(n=32, ticks=60):
+    return SimConfig(max_nnb=n, single_failure=False, drop_msg=False,
+                     seed=0, total_ticks=ticks, fail_tick=20,
+                     rejoin_after=15)
+
+
+def _dense_drop(n=24, ticks=80):
+    return SimConfig(max_nnb=n, single_failure=True, drop_msg=True,
+                     msg_drop_prob=0.1, seed=0, total_ticks=ticks,
+                     fail_tick=30)
+
+
+def _overlay_churn(n=64, ticks=64):
+    return SimConfig(max_nnb=n, model="overlay", single_failure=False,
+                     drop_msg=False, seed=0, total_ticks=ticks,
+                     churn_rate=0.25, rejoin_after=16, step_rate=8.0 / n)
+
+
+def _overlay_drop(n=64, ticks=64):
+    return SimConfig(max_nnb=n, model="overlay", single_failure=True,
+                     drop_msg=True, msg_drop_prob=0.1, seed=0,
+                     total_ticks=ticks, fail_tick=30, step_rate=8.0 / n,
+                     drop_open_tick=10, drop_close_tick=50)
+
+
+def _assert_state_equal(ref_state, lane_state, fields, ctx):
+    for f in fields:
+        a = np.asarray(getattr(ref_state, f))
+        b = np.asarray(getattr(lane_state, f))
+        assert np.array_equal(a, b), f"{ctx}: state field {f} diverged"
+
+
+def test_fleet_dense_bench_parity_churn():
+    """B=4 churn seeds as a fleet == 4 sequential run_bench calls."""
+    cfg = _dense_churn()
+    fleet = FleetSimulation(cfg).run_bench(seeds=SEEDS)
+    sim = Simulation(cfg)
+    assert fleet.batch == len(SEEDS)
+    for i, s in enumerate(SEEDS):
+        ref = sim.run_bench(seed=s)
+        lane = fleet.lanes[i]
+        _assert_state_equal(ref.final_state, lane.final_state,
+                            STATE_FIELDS, f"lane {i}")
+        assert np.array_equal(ref.sent, lane.sent), i
+        assert np.array_equal(ref.recv, lane.recv), i
+        assert lane.counter_stream_width == ref.counter_stream_width
+
+
+def test_fleet_dense_trace_parity_drop10():
+    """Trace-mode fleet: events (and so grades) match sequential."""
+    cfg = _dense_drop()
+    fleet = FleetSimulation(cfg).run(seeds=SEEDS)
+    sim = Simulation(cfg)
+    for i, s in enumerate(SEEDS):
+        ref = sim.run(seed=s)
+        lane = fleet.lanes[i]
+        assert np.array_equal(ref.added, lane.added), i
+        assert np.array_equal(ref.removed, lane.removed), i
+        assert np.array_equal(ref.sent, lane.sent), i
+        assert np.array_equal(ref.recv, lane.recv), i
+        _assert_state_equal(ref.final_state, lane.final_state,
+                            STATE_FIELDS, f"lane {i}")
+
+
+def test_fleet_dense_trace_chunked_matches_unchunked():
+    """Tick-chunking is a staging detail: same events either way."""
+    cfg = _dense_drop(ticks=50)
+    whole = FleetSimulation(cfg).run(seeds=[7, 8])
+    parts = FleetSimulation(cfg, chunk_ticks=16).run(seeds=[7, 8])
+    for w, p in zip(whole.lanes, parts.lanes):
+        assert np.array_equal(w.added, p.added)
+        assert np.array_equal(w.sent, p.sent)
+        _assert_state_equal(w.final_state, p.final_state, STATE_FIELDS,
+                            "chunked")
+
+
+@pytest.mark.parametrize("make_cfg", [_overlay_churn, _overlay_drop],
+                         ids=["churn", "drop10"])
+def test_fleet_overlay_parity(make_cfg):
+    """Overlay fleet (vmapped XLA tick, shared clock): per-lane states
+    and metrics bit-equal to sequential; live_uncovered reports the
+    same -1 sentinel the mega/grid kernels use."""
+    from gossip_protocol_tpu.models.overlay import OverlaySimulation
+    cfg = make_cfg()
+    fleet = FleetSimulation(cfg).run(seeds=SEEDS)
+    for i, s in enumerate(SEEDS):
+        ref = OverlaySimulation(cfg.replace(seed=s), use_pallas=False).run()
+        lane = fleet.lanes[i]
+        _assert_state_equal(ref.final_state, lane.final_state,
+                            OV_STATE_FIELDS, f"lane {i}")
+        for m in OV_METRIC_FIELDS:
+            a = np.asarray(getattr(ref.metrics, m))
+            b = np.asarray(getattr(lane.metrics, m))
+            assert np.array_equal(a, b), f"lane {i}: metric {m}"
+        assert np.all(np.asarray(lane.metrics.live_uncovered) == -1)
+        # host-side coverage validation still works on lane states
+        lane.final_coverage()
+
+
+@pytest.mark.parametrize("make_cfg", [_overlay_churn, _overlay_drop],
+                         ids=["churn", "drop10"])
+def test_grid_fleet_kernel_parity(make_cfg):
+    """The batched grid kernel (leading batch grid dimension) replays
+    each lane of the single-lane grid run bit-for-bit — and therefore
+    the XLA tick too (tests/test_overlay_grid.py closes that leg)."""
+    from gossip_protocol_tpu.models.overlay import (init_overlay_state,
+                                                    make_overlay_schedule)
+    from gossip_protocol_tpu.models.overlay_grid import (
+        make_grid_fleet_run, make_grid_run)
+    cfg = make_cfg()
+    ticks = 20          # one full GRID_TICKS launch + a remainder
+    cfgs = [cfg.replace(seed=s) for s in (5, 6)]
+    scheds = [make_overlay_schedule(c) for c in cfgs]
+    states = _stack_states([init_overlay_state(c) for c in cfgs])
+    run_f = make_grid_fleet_run(cfg, ticks, 2, block_rows=32,
+                                start_tick=0)
+    ff, mf = run_f(states, stack_lanes(scheds))
+    for i, c in enumerate(cfgs):
+        run_1 = make_grid_run(c, ticks, block_rows=32, start_tick=0)
+        f1, m1 = run_1(init_overlay_state(c), scheds[i])
+        _assert_state_equal(f1, _lane_state(ff, i), OV_STATE_FIELDS,
+                            f"lane {i}")
+        for m in OV_METRIC_FIELDS:
+            a = np.asarray(getattr(m1, m))
+            b = np.asarray(getattr(mf, m))[i]
+            assert np.array_equal(a, b), f"lane {i}: metric {m}"
+        assert np.all(np.asarray(mf.live_uncovered) == -1)
+
+
+def test_grid_fleet_rejects_wrong_clock():
+    from gossip_protocol_tpu.models.overlay import (init_overlay_state,
+                                                    make_overlay_schedule)
+    from gossip_protocol_tpu.models.overlay_grid import make_grid_fleet_run
+    cfg = _overlay_churn()
+    states = _stack_states([init_overlay_state(cfg)] * 2)
+    states = states.replace(tick=states.tick + 3)
+    run = make_grid_fleet_run(cfg, 16, 2, block_rows=32, start_tick=0)
+    with pytest.raises(ValueError, match="start tick"):
+        run(states, stack_lanes([make_overlay_schedule(cfg)] * 2))
+
+
+def test_fleet_rejects_mixed_shapes():
+    cfg = _dense_churn()
+    other = cfg.replace(total_ticks=cfg.total_ticks + 1)
+    with pytest.raises(ValueError, match="shape"):
+        FleetSimulation(cfg).run_bench(configs=[cfg, other])
+    with pytest.raises(ValueError, match="exactly one"):
+        FleetSimulation(cfg).run_bench()
+
+
+def test_fleet_grader_full_marks(testcases_dir, tmp_path):
+    """The three course scenarios as ONE B=3 fleet grade 90/90 —
+    same totals as the sequential grade_all path."""
+    from gossip_protocol_tpu.grader import grade_all_fleet
+    results = grade_all_fleet(testcases_dir, str(tmp_path))
+    assert results["total"] == 90, {
+        k: (v.points if hasattr(v, "points") else v)
+        for k, v in results.items()}
+
+
+def test_ticks_per_second_zero_length_segment():
+    """Satellite regression: a zero-length resumed segment must not
+    raise ZeroDivisionError from the throughput properties."""
+    cfg = SimConfig(max_nnb=8, total_ticks=10)
+    sim = Simulation(cfg)
+    full = sim.run()
+    # resuming at/after the end tick runs 0 ticks in ~0 wall seconds
+    empty = sim.run(resume_from=full.final_state)
+    assert empty.ticks_run == 0
+    assert empty.ticks_per_second == 0.0
+    assert empty.node_ticks_per_second == 0.0
+    # explicit degenerate wall clock (sub-resolution timer)
+    degen = SimResult(
+        cfg=cfg, start_tick=full.start_tick, fail_tick=full.fail_tick,
+        rejoin_tick=full.rejoin_tick, added=None, removed=None,
+        sent=np.zeros((8, 5), np.int32), recv=np.zeros((8, 5), np.int32),
+        final_state=full.final_state, wall_seconds=0.0)
+    assert degen.ticks_per_second == 0.0
+
+
+def test_run_bench_no_rebuild():
+    """Satellite regression: a second ``run_bench(seed=...)`` reuses
+    the cached bench run — no new whole-run build (the cache key is
+    config shape, seeds flow through the Schedule arrays)."""
+    from gossip_protocol_tpu.core.tick import run_build_count
+    cfg = SimConfig(max_nnb=16, single_failure=True, total_ticks=30)
+    sim = Simulation(cfg)
+    sim.run_bench(seed=1)
+    built = run_build_count()
+    fn = sim._bench_run
+    sim.run_bench(seed=2)
+    sim.run_bench(seed=3, warmup=False)
+    assert run_build_count() == built, \
+        "reseeded run_bench rebuilt its compiled run"
+    assert sim._bench_run is fn
+    # a second Simulation of the same shape shares the process cache
+    Simulation(cfg).run_bench(seed=4)
+    assert run_build_count() == built
