@@ -497,6 +497,32 @@ def main():
         secondary[f"fleet{fb}_aggregate_node_ticks_per_s_"
                   f"n{n_overlay}_overlay_churn20"] = round(agg, 1)
 
+        # serving-layer replay (gossip_protocol_tpu/service/): a mixed
+        # request stream — the three grader scenario kinds x two size
+        # tiers — through the continuous-batching scheduler, with
+        # per-request bit-parity enforced inside replay().  Emits the
+        # serving metrics schema (docs/SERVING.md).
+        from gossip_protocol_tpu.service import (grader_templates,
+                                                 overlay_templates)
+        from gossip_protocol_tpu.service import replay as service_replay
+        n_sv, t_sv, seeds_sv = (256, 48, 2) if smoke else (512, 96, 8)
+        # batch width must fit the stream: padding 2-seed smoke
+        # buckets to 8 lanes would be 75% filler work
+        sv = service_replay(
+            grader_templates() + overlay_templates(n=n_sv, ticks=t_sv),
+            seeds_per_template=seeds_sv, max_batch=min(8, 2 * seeds_sv))
+        secondary["service_replay_mixed"] = {
+            "requests": sv["requests"],
+            "speedup_vs_sequential": sv["speedup_vs_sequential"],
+            "aggregate_node_ticks_per_s": sv["aggregate_node_ticks_per_s"],
+            "latency_p50_s": sv["latency_p50_s"],
+            "latency_p95_s": sv["latency_p95_s"],
+            "mean_occupancy": sv["mean_occupancy"],
+            "cache_hit_rate": sv["cache_hit_rate"],
+            "buckets": sv["buckets"],
+            "max_builds_per_bucket": sv["max_builds_per_bucket"],
+        }
+
     secondary.update({
         f"n{n_drop}_overlay_drop10": _overlay_entry(drop, backend),
         f"n{n_dense}_fullview": _entry(dense_cfg, dense, backend),

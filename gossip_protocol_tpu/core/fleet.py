@@ -62,9 +62,71 @@ WORLD_AXES = WorldState(tick=None, in_group=0, own_hb=0, known=0, hb=0,
                         ts=0, gossip=0, joinreq=0, joinrep=0, rng=0)
 EVENT_AXES = TickEvents(added=0, removed=0, sent=0, recv=0)
 
+#: Schedule axes when every lane shares one drop plan: the per-lane
+#: injection arrays stay batched (seeds move victims), but
+#: ``drop_active``/``drop_prob`` ride UNBATCHED, exactly like the
+#: clock.  Load-bearing the same way the shared clock is: the drop
+#: draw sits under a ``lax.cond`` on ``drop_active[t]``
+#: (ops/drop.py), and a batched predicate degrades it to a
+#: both-branches select — the per-tick threefry draw then runs on
+#: EVERY tick of a no-drop config instead of never (measured 2.6x
+#: the whole vmapped dense tick at n=24).  Lanes that genuinely
+#: disagree on the drop plan fall back to SCHED_AXES_BATCHED.
+SCHED_AXES_SHARED_DROP = Schedule(start_tick=0, fail_tick=0,
+                                  rejoin_tick=0, drop_active=None,
+                                  drop_prob=None)
+SCHED_AXES_BATCHED = Schedule(start_tick=0, fail_tick=0, rejoin_tick=0,
+                              drop_active=0, drop_prob=0)
+
+
+def _shared_drop(cfgs) -> bool:
+    """May the fleet share one unbatched drop plan across lanes?"""
+    c0 = cfgs[0]
+    return all((c.drop_msg, c.drop_open_tick, c.drop_close_tick,
+                c.msg_drop_prob)
+               == (c0.drop_msg, c0.drop_open_tick, c0.drop_close_tick,
+                   c0.msg_drop_prob) for c in cfgs[1:])
+
+
+def _stack_scheds(scheds, shared_drop: bool):
+    """Stack per-lane schedules; one shared drop plan when allowed."""
+    if not shared_drop:
+        return stack_lanes(scheds)
+    return Schedule(
+        start_tick=jnp.stack([s.start_tick for s in scheds]),
+        fail_tick=jnp.stack([s.fail_tick for s in scheds]),
+        rejoin_tick=jnp.stack([s.rejoin_tick for s in scheds]),
+        drop_active=scheds[0].drop_active,
+        drop_prob=scheds[0].drop_prob)
+
 
 def stack_lanes(trees):
-    """Stack same-shape pytrees on a new leading lane axis."""
+    """Stack same-shape pytrees on a new leading lane axis.
+
+    Mismatched lanes are rejected up front with the offending lane and
+    field named — ``jnp.stack`` (or worse, the vmapped program it
+    feeds) would otherwise fail deep inside tracing with no hint of
+    which request caused it.
+    """
+    trees = list(trees)
+    paths0, treedef0 = jax.tree_util.tree_flatten_with_path(trees[0])
+    for i, t in enumerate(trees[1:], start=1):
+        paths, treedef = jax.tree_util.tree_flatten_with_path(t)
+        if treedef != treedef0:
+            raise ValueError(
+                f"lane {i} has a different pytree structure than lane 0 "
+                f"({treedef} != {treedef0}); fleets stack same-shape "
+                "lanes only")
+        for (p0, leaf0), (p, leaf) in zip(paths0, paths):
+            s0 = jnp.shape(leaf0)
+            s = jnp.shape(leaf)
+            if s != s0:
+                field = jax.tree_util.keystr(p)
+                raise ValueError(
+                    f"lane {i} field {field} has shape {s}, but lane 0 "
+                    f"has {s0}; fleets stack same-shape lanes only "
+                    "(check the lane's config: peer count and tick "
+                    "count set these shapes)")
     return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
 
 
@@ -98,6 +160,52 @@ def fleet_shape_key(cfg: SimConfig):
             cfg.rejoin_after is None)
 
 
+def _shape_mismatch(fleet_cfg: SimConfig, lane_cfg: SimConfig) -> str:
+    """Name the config fields that break a lane's shape compatibility.
+
+    Listing ``field=lane_value != fleet_value`` per offending field
+    turns "failed deep inside vmap" into an actionable message: the
+    caller learns exactly which knob (peer count, tick count, a whole
+    overlay field) to fix on which lane.
+    """
+    if lane_cfg.model != fleet_cfg.model:
+        return (f"model={lane_cfg.model!r} != fleet "
+                f"model={fleet_cfg.model!r}")
+    if fleet_cfg.model == "overlay":
+        # the overlay compiles ~the whole config statically, so every
+        # non-seed field is shape-relevant
+        names = [f.name for f in dataclasses.fields(SimConfig)
+                 if f.name != "seed"]
+    else:
+        names = ["max_nnb", "t_remove", "total_ticks"]
+    diffs = [f"{n}={getattr(lane_cfg, n)!r} != fleet "
+             f"{n}={getattr(fleet_cfg, n)!r}"
+             for n in names
+             if getattr(lane_cfg, n) != getattr(fleet_cfg, n)]
+    if fleet_cfg.model != "overlay" and \
+            (lane_cfg.rejoin_after is None) != (fleet_cfg.rejoin_after is None):
+        diffs.append(f"rejoin_after={lane_cfg.rejoin_after!r} != fleet "
+                     f"rejoin_after={fleet_cfg.rejoin_after!r}")
+    return ", ".join(diffs) or "(keys differ)"
+
+
+#: Compiled fleet programs, shared across FleetSimulation instances
+#: (exactly like core/tick._RUN_CACHE for single runs).  Keys carry
+#: the fleet shape key, the segment-plan signature, and the batch
+#: geometry; misses are counted through core.tick.note_build so the
+#: serving layer's "one build per distinct bucket key" contract is a
+#: run_build_count delta.
+_FLEET_FN_CACHE: dict = {}
+
+
+def _fleet_fn(key, builder):
+    if key not in _FLEET_FN_CACHE:
+        from .tick import note_build
+        note_build()
+        _FLEET_FN_CACHE[key] = builder()
+    return _FLEET_FN_CACHE[key]
+
+
 @dataclass
 class FleetResult:
     """A finished fleet: per-lane results plus the one shared wall.
@@ -107,14 +215,29 @@ class FleetResult:
     ``wall_seconds`` is the FLEET wall clock — a lane's own
     ``*_per_second`` therefore reads as "if I had run alone at fleet
     cost"; the aggregate properties below are the fleet's throughput.
+
+    When the program executed with trailing filler lanes (a partial
+    service batch padded to the compiled width, ``n_real=`` on
+    :meth:`FleetSimulation.run`/:meth:`~FleetSimulation.run_bench`),
+    ``lanes`` holds only the REAL lanes — filler results are never
+    unstacked — and ``padded_batch``/``occupancy`` record the padding.
     """
 
     lanes: list
     wall_seconds: float
+    #: compiled batch width actually dispatched (>= len(lanes) when
+    #: filler lanes padded a partial batch; 0 = no padding happened)
+    padded_batch: int = 0
 
     @property
     def batch(self) -> int:
         return len(self.lanes)
+
+    @property
+    def occupancy(self) -> float:
+        """Real-lane fraction of the dispatched program (1.0 unpadded)."""
+        width = self.padded_batch or self.batch
+        return self.batch / width if width else 0.0
 
     @property
     def total_node_ticks(self) -> int:
@@ -139,9 +262,24 @@ class FleetSimulation:
     bench mode) with either ``seeds=[...]`` (the common case: distinct
     seeds of ``cfg``) or ``configs=[...]`` (same-shape configs — e.g.
     the grader's three course scenarios, whose differences are all
-    Schedule data).  Compiled fleet programs are cached per (mode,
-    batch width, chunk length) on the instance; ``make_tick`` builds
-    are shared process-wide as usual.
+    Schedule data).  Compiled fleet programs are cached process-wide
+    (``_FLEET_FN_CACHE``) per (shape key, segment-plan signature,
+    mode, batch width, chunk length), so every FleetSimulation of the
+    same shape shares one build — the serving layer
+    (service/cache.py) leans on this for its one-build-per-bucket
+    contract.
+
+    ``n_real=k`` marks the trailing ``B - k`` lanes as FILLER: a
+    partial batch padded up to an already-compiled width.  Filler
+    lanes execute like any other lane but are masked out of the
+    host-side result path — their events never enter the sparse
+    device->host compaction (they cannot inflate its budget or flip
+    it to the dense fallback) and they are never unstacked into
+    ``FleetResult.lanes``.  vmap lanes are data-independent by
+    construction (the only shared carry is the unbatched clock, which
+    every lane advances identically), so filler cannot perturb real
+    lanes' results — pinned bit-for-bit by
+    tests/test_service.py::test_padding_parity.
 
     The vmapped paths force the pure-XLA tick (``use_pallas=False``):
     vmap-of-``pallas_call`` is never sound here, and the TPU fleet
@@ -155,7 +293,17 @@ class FleetSimulation:
         self.cfg = cfg
         self.block_size = block_size
         self.chunk_ticks = chunk_ticks
-        self._fns: dict = {}
+
+    @staticmethod
+    def _resolve_n_real(batch: int, n_real) -> int:
+        if n_real is None:
+            return batch
+        if not 1 <= n_real <= batch:
+            raise ValueError(
+                f"n_real={n_real} must be in [1, {batch}] (the fleet "
+                f"dispatched {batch} lanes; filler lanes are the "
+                "trailing ones)")
+        return int(n_real)
 
     # ---- lane validation -------------------------------------------
     def _lane_cfgs(self, seeds, configs) -> list[SimConfig]:
@@ -167,22 +315,29 @@ class FleetSimulation:
         if not configs:
             raise ValueError("empty fleet")
         key = fleet_shape_key(self.cfg)
-        for c in configs:
+        for i, c in enumerate(configs):
             if fleet_shape_key(c) != key:
                 raise ValueError(
-                    f"lane config {c} does not share the fleet's "
-                    f"compiled shape {key}; fleets batch same-shape "
-                    "simulations only")
+                    f"lane {i} does not share the fleet's compiled "
+                    f"shape: {_shape_mismatch(self.cfg, c)}; fleets "
+                    "batch same-shape simulations only")
         return configs
 
+    # ---- shared program cache ---------------------------------------
+    def _cache_key(self, *extra):
+        from ..models.segments import plan_signature
+        return (fleet_shape_key(self.cfg), plan_signature(self.cfg),
+                self.block_size) + extra
+
     # ---- dense bench ------------------------------------------------
-    def _dense_bench_fn(self, batch: int, width: int):
-        key = ("bench", batch, width)
-        if key not in self._fns:
+    def _dense_bench_fn(self, batch: int, width: int, shared_drop: bool):
+        def build():
             cfg_w = self.cfg.replace(max_nnb=width)
             tick = make_tick(cfg_w, self.block_size, use_pallas=False,
                              with_events=False)
-            vtick = jax.vmap(tick, in_axes=(WORLD_AXES, 0),
+            axes = SCHED_AXES_SHARED_DROP if shared_drop \
+                else SCHED_AXES_BATCHED
+            vtick = jax.vmap(tick, in_axes=(WORLD_AXES, axes),
                              out_axes=(WORLD_AXES, EVENT_AXES))
             total = self.cfg.total_ticks
 
@@ -193,11 +348,13 @@ class FleetSimulation:
                     return carry, (ev.sent, ev.recv)
                 return jax.lax.scan(step, states, None, length=total)
 
-            self._fns[key] = run
-        return self._fns[key]
+            return run
 
-    def run_bench(self, seeds=None, configs=None,
-                  warmup: bool = True) -> FleetResult:
+        return _fleet_fn(self._cache_key("bench", batch, width,
+                                         shared_drop), build)
+
+    def run_bench(self, seeds=None, configs=None, warmup: bool = True,
+                  n_real: Optional[int] = None) -> FleetResult:
         """Bench-mode fleet: whole runs on device, one shared timing.
 
         Mirrors ``Simulation.run_bench`` semantics per lane — always a
@@ -206,10 +363,12 @@ class FleetSimulation:
         the corner width (core/dense_corner.py; the bound is
         config-derived, so every lane shares it).  Counters follow the
         same stream-width caveat (``SimResult.counter_stream_width``).
+        ``n_real`` marks trailing lanes as filler (see class docs).
         """
         cfgs = self._lane_cfgs(seeds, configs)
+        nr = self._resolve_n_real(len(cfgs), n_real)
         if self.cfg.model == "overlay":
-            return self._overlay_fleet(cfgs, warmup)
+            return self._overlay_fleet(cfgs, warmup, nr)
         from .dense_corner import (_embed_state, active_bound,
                                    bench_stream_width)
         bounds = {active_bound(c) for c in cfgs}
@@ -222,7 +381,8 @@ class FleetSimulation:
         total = self.cfg.total_ticks
         corner = 0 < a < n
         width = a if corner else n
-        run = self._dense_bench_fn(len(cfgs), width)
+        shared = _shared_drop(cfgs)
+        run = self._dense_bench_fn(len(cfgs), width, shared)
         scheds = [make_schedule(c) for c in cfgs]
         if corner:
             lane_scheds = [Schedule(
@@ -232,7 +392,7 @@ class FleetSimulation:
                 for s in scheds]
         else:
             lane_scheds = scheds
-        sscheds = stack_lanes(lane_scheds)
+        sscheds = _stack_scheds(lane_scheds, shared)
         cfg_w = self.cfg.replace(max_nnb=width)
 
         def fresh_states():
@@ -249,10 +409,11 @@ class FleetSimulation:
         if int(np.asarray(final.tick)) != total:
             raise RuntimeError("fleet bench did not complete all ticks")
         wall = time.perf_counter() - t0
-        # (T, B, width) counter stacks -> per-lane (N, T)
-        sr = np.asarray(jnp.stack([sent, recv]))
+        # (T, B, width) counter stacks -> per-lane (N, T); filler
+        # lanes' counters are sliced away before they reach the host
+        sr = np.asarray(jnp.stack([sent, recv])[:, :, :nr])
         lanes = []
-        for i, (c, s) in enumerate(zip(cfgs, scheds)):
+        for i, (c, s) in enumerate(zip(cfgs[:nr], scheds[:nr])):
             fs = _lane_state(final, i)
             if corner:
                 fs = _embed_state(fs, n)
@@ -269,15 +430,17 @@ class FleetSimulation:
                 wall_seconds=wall,
                 counter_stream_width=bench_stream_width(c),
             ))
-        return FleetResult(lanes=lanes, wall_seconds=wall)
+        return FleetResult(lanes=lanes, wall_seconds=wall,
+                           padded_batch=len(cfgs) if nr < len(cfgs) else 0)
 
     # ---- dense trace -------------------------------------------------
-    def _dense_trace_fn(self, batch: int, length: int):
-        key = ("trace", batch, length)
-        if key not in self._fns:
+    def _dense_trace_fn(self, batch: int, length: int, shared_drop: bool):
+        def build():
             tick = make_tick(self.cfg, self.block_size, use_pallas=False,
                              with_events=True)
-            vtick = jax.vmap(tick, in_axes=(WORLD_AXES, 0),
+            axes = SCHED_AXES_SHARED_DROP if shared_drop \
+                else SCHED_AXES_BATCHED
+            vtick = jax.vmap(tick, in_axes=(WORLD_AXES, axes),
                              out_axes=(WORLD_AXES, EVENT_AXES))
 
             @partial(jax.jit, donate_argnums=(0,))
@@ -286,21 +449,30 @@ class FleetSimulation:
                     return vtick(carry, scheds)
                 return jax.lax.scan(step, states, None, length=length)
 
-            self._fns[key] = run
-        return self._fns[key]
+            return run
 
-    def run(self, seeds=None, configs=None) -> FleetResult:
+        return _fleet_fn(self._cache_key("trace", batch, length,
+                                         shared_drop), build)
+
+    def run(self, seeds=None, configs=None, n_real: Optional[int] = None,
+            warmup: bool = True) -> FleetResult:
         """Trace-mode fleet (dense): full event masks for every lane.
 
         Chunked over ticks like ``Simulation.run`` (the per-chunk
         device budget is divided by B), with the sparse event staging
         done ONCE across the whole batch per chunk.  Overlay configs
         dispatch to the metrics-mode fleet (the overlay has no dense
-        event masks by design).
+        event masks by design); ``warmup`` only affects that path —
+        the service scheduler passes ``False`` so a dispatch never
+        executes its fleet twice just to exclude compile time from
+        ``wall_seconds``.  ``n_real`` marks trailing lanes as filler:
+        they run on device but are masked out of the event staging and
+        result unstacking entirely (see class docs).
         """
         cfgs = self._lane_cfgs(seeds, configs)
+        nr = self._resolve_n_real(len(cfgs), n_real)
         if self.cfg.model == "overlay":
-            return self._overlay_fleet(cfgs, warmup=True)
+            return self._overlay_fleet(cfgs, warmup=warmup, n_real=nr)
         b = len(cfgs)
         n = self.cfg.n
         total = self.cfg.total_ticks
@@ -308,29 +480,33 @@ class FleetSimulation:
         if chunk is None:
             per_tick = 2 * n * n * b
             chunk = max(1, min(total, (1 << 30) // max(per_tick, 1)))
+        shared = _shared_drop(cfgs)
         scheds = [make_schedule(c) for c in cfgs]
-        sscheds = stack_lanes(scheds)
+        sscheds = _stack_scheds(scheds, shared)
         states = _stack_states([init_state(c) for c in cfgs])
         added, removed, sent, recv = [], [], [], []
         t0 = time.perf_counter()
         done = 0
         while done < total:
             length = min(chunk, total - done)
-            run = self._dense_trace_fn(b, length)
+            run = self._dense_trace_fn(b, length, shared)
             states, ev = run(states, sscheds)
-            # one sparse compaction for the whole (length*B, N, N) stack
+            # one sparse compaction for the whole (length*n_real, N, N)
+            # stack — filler lanes are sliced off ON DEVICE first, so
+            # their events can neither inflate the sparse budget nor
+            # tip the transfer into the dense fallback
             nw = (n + 31) // 32
-            cap = max(1 << 14, (2 * length * b * n * nw) // 16)
-            a_h, r_h = _masks_to_host(ev.added.reshape(length * b, n, n),
-                                      ev.removed.reshape(length * b, n, n),
-                                      cap)
-            added.append(a_h.reshape(length, b, n, n))
-            removed.append(r_h.reshape(length, b, n, n))
+            cap = max(1 << 14, (2 * length * nr * n * nw) // 16)
+            a_h, r_h = _masks_to_host(
+                ev.added[:, :nr].reshape(length * nr, n, n),
+                ev.removed[:, :nr].reshape(length * nr, n, n), cap)
+            added.append(a_h.reshape(length, nr, n, n))
+            removed.append(r_h.reshape(length, nr, n, n))
             if n <= 8192:
-                sr = np.asarray(jnp.stack([ev.sent, ev.recv])
+                sr = np.asarray(jnp.stack([ev.sent, ev.recv])[:, :, :nr]
                                 .astype(jnp.int16)).astype(np.int32)
             else:
-                sr = np.asarray(jnp.stack([ev.sent, ev.recv]))
+                sr = np.asarray(jnp.stack([ev.sent, ev.recv])[:, :, :nr])
             sent.append(sr[0])
             recv.append(sr[1])
             done += length
@@ -338,7 +514,7 @@ class FleetSimulation:
             raise RuntimeError("fleet trace did not complete all ticks")
         wall = time.perf_counter() - t0
         lanes = []
-        for i, (c, s) in enumerate(zip(cfgs, scheds)):
+        for i, (c, s) in enumerate(zip(cfgs[:nr], scheds[:nr])):
             lanes.append(SimResult(
                 cfg=c,
                 start_tick=np.asarray(s.start_tick),
@@ -351,15 +527,17 @@ class FleetSimulation:
                 final_state=_lane_state(states, i),
                 wall_seconds=wall,
             ))
-        return FleetResult(lanes=lanes, wall_seconds=wall)
+        return FleetResult(lanes=lanes, wall_seconds=wall,
+                           padded_batch=b if nr < b else 0)
 
     # ---- overlay (metrics mode) --------------------------------------
-    def _overlay_fleet(self, cfgs: Sequence[SimConfig],
-                       warmup: bool) -> FleetResult:
+    def _overlay_fleet(self, cfgs: Sequence[SimConfig], warmup: bool,
+                       n_real: Optional[int] = None) -> FleetResult:
         from ..models.overlay import (OverlayResult, init_overlay_state,
                                       make_overlay_fleet_run,
                                       make_overlay_schedule)
         b = len(cfgs)
+        nr = self._resolve_n_real(b, n_real)
         total = self.cfg.total_ticks
         run = make_overlay_fleet_run(self.cfg, b)
         scheds = [make_overlay_schedule(c) for c in cfgs]
@@ -377,11 +555,14 @@ class FleetSimulation:
         if int(np.asarray(final.tick)) != total:
             raise RuntimeError("fleet overlay run did not complete")
         wall = time.perf_counter() - t0
-        metrics_h = jax.tree.map(np.asarray, metrics)
+        # filler lanes are dropped on device before the (B, T) metric
+        # stacks cross to host
+        metrics_h = jax.tree.map(lambda m: np.asarray(m[:nr]), metrics)
         lanes = [OverlayResult(
             cfg=c, sched=scheds[i],
             final_state=_lane_state(final, i),
             metrics=jax.tree.map(lambda m, _i=i: m[_i], metrics_h),
             wall_seconds=wall,
-        ) for i, c in enumerate(cfgs)]
-        return FleetResult(lanes=lanes, wall_seconds=wall)
+        ) for i, c in enumerate(cfgs[:nr])]
+        return FleetResult(lanes=lanes, wall_seconds=wall,
+                           padded_batch=b if nr < b else 0)

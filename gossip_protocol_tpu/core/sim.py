@@ -196,7 +196,13 @@ class Simulation:
         cache key contains no seed either).  One build therefore
         serves every ``run_bench(seed=...)`` call; regression-pinned
         by tests/test_fleet.py::test_run_bench_no_rebuild via
-        ``core.tick.run_build_count``.
+        ``core.tick.run_build_count``.  The key does, however, carry
+        the segment-plan signature (models/segments.plan_signature):
+        a config edit that only moves a phase boundary — a shifted
+        drop window, a later fail tick — compiles fresh instead of
+        being served the old boundaries' program
+        (tests/test_service.py::
+        test_run_bench_cache_key_includes_plan_signature).
         """
         if self._bench_run is None:
             self._bench_run = make_run(self.cfg, self.block_size,

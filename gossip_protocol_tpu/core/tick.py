@@ -349,8 +349,26 @@ _BUILD_COUNT = 0
 
 
 def run_build_count() -> int:
-    """Number of whole-run functions built so far (cache misses)."""
+    """Number of whole-run functions built so far (cache misses).
+
+    Counts every process-wide whole-run construction: :func:`make_run`
+    misses here, fleet-program misses in core/fleet.py and
+    models/overlay.make_overlay_fleet_run (via :func:`note_build`).
+    The serving layer (service/) keys its compiled-program cache on
+    the same shape signatures, so "a 20-request mixed trace builds at
+    most once per distinct bucket key" is a delta on this counter
+    (tests/test_service.py)."""
     return _BUILD_COUNT
+
+
+def note_build() -> None:
+    """Record a whole-run build performed outside :func:`make_run`.
+
+    Called by the fleet-program caches (core/fleet.py,
+    models/overlay.py) on a cache miss so :func:`run_build_count`
+    stays the single process-wide build odometer."""
+    global _BUILD_COUNT
+    _BUILD_COUNT += 1
 
 
 def make_run(cfg: SimConfig, block_size: int = 128, with_events: bool = True,
@@ -363,6 +381,7 @@ def make_run(cfg: SimConfig, block_size: int = 128, with_events: bool = True,
     """
     global _BUILD_COUNT
     comm = LocalComm(use_pallas)
+    from ..models.segments import plan_signature
     from .dense_corner import active_bound, make_corner_run
     from .dense_mega import dense_mega_supported, make_dense_mega_run
     mega = comm.use_pallas and dense_mega_supported(cfg, with_events)
@@ -371,9 +390,17 @@ def make_run(cfg: SimConfig, block_size: int = 128, with_events: bool = True,
     # saves (N/A)^3 of the work and rides the megakernel internally
     # whenever the corner width fits its envelope
     corner = (not with_events) and 0 < a < cfg.n
+    # the segment-plan signature (closed-form phase windows) is part of
+    # the key so a config edit that only moves a phase boundary — a
+    # shifted drop window, a later fail tick — can never be served a
+    # compiled run built for the old boundaries.  Today every dense
+    # path reads those boundaries from the Schedule arrays (data, not
+    # code), so the extra key bits cost at most a redundant build; any
+    # future path that bakes a window statically (the overlay grid
+    # kernel already does) is covered by construction.
     key = (cfg.n, cfg.t_remove, cfg.total_ticks, block_size, with_events,
            comm.use_pallas, mega, cfg.rejoin_after is not None,
-           a if corner else cfg.n)
+           a if corner else cfg.n, plan_signature(cfg))
     if key in _RUN_CACHE:
         return _RUN_CACHE[key]
     _BUILD_COUNT += 1

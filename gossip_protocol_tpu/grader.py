@@ -134,14 +134,19 @@ def grade_multi(dbg_path: str, n: int = 10) -> ScenarioGrade:
     return g
 
 
-def grade_all(run_scenario_fn, testcases_dir: str = "testcases",
+def grade_all(run_scenario_fn=None, testcases_dir: str = "testcases",
               workdir: str = ".") -> dict:
     """Grade the three shipped scenarios; mirrors Grader.sh's totals.
 
     ``run_scenario_fn(conf_path, workdir)`` must produce
     ``workdir/dbg.log`` for the given testcase (the grader recompiles
     and reruns the binary per scenario; we re-simulate per scenario).
+    With the default ``run_scenario_fn=None`` the scenarios are served
+    through the fleet service instead (:func:`grade_all_service`) —
+    same totals, batched execution.
     """
+    if run_scenario_fn is None:
+        return grade_all_service(testcases_dir, workdir)
     dbg = os.path.join(workdir, "dbg.log")
     results = {}
 
@@ -205,6 +210,46 @@ def grade_all_fleet(testcases_dir: str = "testcases",
     return results
 
 
+def grade_all_service(testcases_dir: str = "testcases",
+                      workdir: str = ".", service=None) -> dict:
+    """Grade the three shipped scenarios through the fleet SERVICE.
+
+    The grader is the serving layer's first real client: each scenario
+    is submitted as a trace request to a :class:`~.service.FleetService`
+    and graded from its handle's lane result.  The bucketer does the
+    batching decision — single/multi share one compiled program (equal
+    shape + segment plan), while msgdrop's shifted drop window lands
+    in its own bucket (its segment-plan signature differs; the
+    grid-kernel family bakes that window statically, and the service
+    never assumes which engine path a bucket rides).  Per-lane events
+    are bit-identical to solo runs (tests/test_service.py), so the
+    totals mirror :func:`grade_all` exactly.
+    """
+    from .config import SimConfig
+    from .service import FleetService
+
+    svc = service if service is not None else FleetService(
+        max_batch=len(SCENARIOS), pad_policy="none")
+    handles = [svc.submit(SimConfig.from_conf(
+        os.path.join(testcases_dir, f"{s}.conf")), mode="trace")
+        for s in SCENARIOS]
+    svc.drain()
+    dbg = os.path.join(workdir, "dbg.log")
+    results = {}
+    for name, h in zip(SCENARIOS, handles):
+        h.result().write_logs(workdir)
+        if name == "multifailure":
+            results[name] = grade_multi(dbg)
+        elif name == "msgdropsinglefailure":
+            results[name] = grade_single(dbg, join_pts=15, comp_pts=15,
+                                         acc_pts=None)
+        else:
+            results[name] = grade_single(dbg)
+    results["total"] = sum(r.points for r in results.values()
+                           if isinstance(r, ScenarioGrade))
+    return results
+
+
 def main(argv=None) -> int:
     import argparse
     ap = argparse.ArgumentParser(description="Grade the three scenarios "
@@ -236,9 +281,10 @@ def main(argv=None) -> int:
     if args.platform:
         import jax
         jax.config.update("jax_platforms", args.platform)
-    # the three course scenarios run as a single B=3 fleet (one
-    # compiled program, one dispatch per chunk for all three)
-    results = grade_all_fleet(args.testcases, args.workdir)
+    # the three course scenarios go through the serving layer (the
+    # grader is its first real client): bucketed by compiled shape +
+    # segment plan, batched per bucket (grade_all_service)
+    results = grade_all(None, args.testcases, args.workdir)
     for name, g in results.items():
         if isinstance(g, ScenarioGrade):
             print(f"{name}: join {g.join_points}/{g.join_max}  "

@@ -1081,6 +1081,11 @@ def make_overlay_fleet_run(cfg: SimConfig, batch: int,
     key = (cfg.replace(seed=0), batch, length, grid)
     if key in _OVERLAY_FLEET_CACHE:
         return _OVERLAY_FLEET_CACHE[key]
+    # a miss is a whole-run build: keep core.tick.run_build_count the
+    # single process-wide odometer (the serving layer's one-build-per-
+    # bucket contract is a delta on it)
+    from ..core.tick import note_build
+    note_build()
     if grid:
         run = make_grid_fleet_run(cfg, length, batch, start_tick=0)
         _OVERLAY_FLEET_CACHE[key] = run

@@ -216,3 +216,22 @@ def describe_plan(plan: list[Segment]) -> str:
     """Compact human-readable plan, e.g.
     ``"ramp+join:48 + churn+join:144 + steady:96"``."""
     return " + ".join(f"{s.flags.tag}:{s.ticks}" for s in plan)
+
+
+def plan_signature(cfg: SimConfig) -> tuple:
+    """Hashable seed-independent digest of a config's segment plan.
+
+    Two configs with equal signatures produce identical segment plans
+    at every (start_tick, length, grid_ticks) — the signature is the
+    closed-form phase windows themselves plus the horizon, which is
+    everything :func:`plan_segments` reads.  Used as a compile-cache
+    key component (core/tick.make_run, core/fleet.py) and as part of
+    the serving layer's bucketing key (service/bucket.py): a config
+    edit that only moves a phase boundary (say ``drop_open_tick``)
+    changes the signature, so it can neither be served a stale
+    compiled run nor be batched into a fleet whose kernels elided a
+    phase it still needs.
+    """
+    win = phase_windows(cfg)
+    return ("segplan", cfg.total_ticks, win.last_start, win.fail_lo,
+            win.rejoin_hi, win.join_dead_from, win.drop_lo, win.drop_hi)

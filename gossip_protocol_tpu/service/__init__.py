@@ -1,0 +1,24 @@
+"""Fleet service: continuous-batching simulation serving.
+
+The layer above the batched engine (core/fleet.py): admit a stream of
+heterogeneous ``(config, seed, mode)`` simulation requests, bucket
+them by compiled-shape compatibility (shape key + segment-plan
+signature), pad partial batches with inert filler lanes, and serve
+each bucket through one cached compiled fleet program — per-request
+results bit-identical to solo runs, with per-request latency and
+per-dispatch occupancy metrics.  See docs/SERVING.md.
+"""
+
+from .bucket import bucket_key, pad_configs
+from .cache import ProgramCache
+from .replay import (Template, build_trace, grader_templates,
+                     overlay_templates, replay)
+from .scheduler import PAD_POLICIES, FleetService
+from .types import MODES, RequestHandle, RequestMetrics, SimRequest
+
+__all__ = [
+    "FleetService", "ProgramCache", "RequestHandle", "RequestMetrics",
+    "SimRequest", "Template", "bucket_key", "build_trace",
+    "grader_templates", "overlay_templates", "pad_configs", "replay",
+    "MODES", "PAD_POLICIES",
+]

@@ -1,0 +1,57 @@
+"""Shape-bucketing: which requests may ride one compiled program.
+
+A fleet program bakes a config *shape* (core/fleet.fleet_shape_key)
+and — on paths that specialize per schedule phase — the segment plan
+(models/segments.plan_signature).  The bucket key is exactly that
+union, plus the execution mode and, for dense bench requests, the
+static active-corner width (a bench fleet compiles ONE width, so
+lanes must agree on ``active_bound`` up front rather than fail inside
+``FleetSimulation.run_bench``).
+
+Everything NOT in the key flows through the Schedule arrays as data
+(seeds, victim draws, drop realizations), which is precisely why
+batching within a bucket is exact: per-lane results stay bit-identical
+to solo runs.  The key errs conservative — e.g. two dense trace
+configs differing only in ``drop_open_tick`` could share today's
+compiled program (the window is schedule data there), but they get
+separate buckets because the grid-kernel path does bake that boundary
+and a serving layer must never depend on which engine path a bucket
+lands on.
+
+Partial batches are padded with FILLER lanes: replicas of the
+bucket's first-seen config (same shape by construction, seed
+irrelevant — filler results are masked out device-side and never
+unstacked, core/fleet.py ``n_real``).
+"""
+
+from __future__ import annotations
+
+from ..config import SimConfig
+from ..core.fleet import fleet_shape_key
+from ..models.segments import plan_signature
+from .types import MODES
+
+
+def bucket_key(cfg: SimConfig, mode: str) -> tuple:
+    """Compatibility key: requests with equal keys batch together."""
+    if mode not in MODES:
+        raise ValueError(f"unknown mode {mode!r}; expected one of {MODES}")
+    key = (mode, fleet_shape_key(cfg), plan_signature(cfg))
+    if cfg.model != "overlay":
+        # the plan signature pins the drop WINDOW but not the
+        # probability; one bucket must share the whole drop plan so
+        # the fleet can keep it unbatched (core/fleet.py
+        # SCHED_AXES_SHARED_DROP) — a mixed-prob bucket would silently
+        # degrade to the batched-drop program and compile twice
+        key += (cfg.msg_drop_prob if cfg.drop_msg else None,)
+    if mode == "bench" and cfg.model != "overlay":
+        from ..core.dense_corner import active_bound
+        key += (active_bound(cfg),)
+    return key
+
+
+def pad_configs(cfgs: list, width: int, filler: SimConfig) -> list:
+    """Pad a partial batch to ``width`` lanes with inert filler."""
+    if len(cfgs) > width:
+        raise ValueError(f"batch of {len(cfgs)} exceeds width {width}")
+    return list(cfgs) + [filler] * (width - len(cfgs))

@@ -1,0 +1,71 @@
+"""Compiled-program cache: one FleetSimulation per bucket key.
+
+The expensive artifacts — jitted whole-fleet programs — already live
+in the process-wide ``core.fleet._FLEET_FN_CACHE`` keyed by (shape
+key, segment-plan signature, batch geometry), and every build there
+moves ``core.tick.run_build_count``.  This cache adds the serving
+view of the same thing: bucket key -> the FleetSimulation handle that
+owns the bucket's dispatches, plus hit/miss/build counters so the
+scheduler can report cache behavior per dispatch ("a 20-request mixed
+trace builds at most once per distinct bucket key",
+tests/test_service.py::test_mixed_trace_builds_once_per_bucket).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..config import SimConfig
+from ..core.fleet import FleetSimulation
+from ..core.tick import run_build_count
+
+
+class ProgramCache:
+    """bucket key -> :class:`~..core.fleet.FleetSimulation`."""
+
+    def __init__(self, block_size: int = 128,
+                 chunk_ticks: Optional[int] = None):
+        self._block_size = block_size
+        self._chunk_ticks = chunk_ticks
+        self._sims: dict = {}
+        self.hits = 0
+        self.misses = 0
+        self._builds0 = run_build_count()
+
+    def get(self, key: tuple, cfg: SimConfig) -> FleetSimulation:
+        """The bucket's fleet handle (created on first use).
+
+        ``cfg`` seeds the handle's shape on a miss; later calls with
+        any same-bucket config return the same handle.
+        """
+        sim = self._sims.get(key)
+        if sim is None:
+            self.misses += 1
+            sim = FleetSimulation(cfg, block_size=self._block_size,
+                                  chunk_ticks=self._chunk_ticks)
+            self._sims[key] = sim
+        else:
+            self.hits += 1
+        return sim
+
+    @property
+    def builds(self) -> int:
+        """Whole-run builds observed since this cache was created.
+
+        A process-wide ``run_build_count`` delta: it counts this
+        service's builds plus any other compilation activity in the
+        process — exact when the service is the only driver (the smoke
+        replay), an upper bound otherwise.
+        """
+        return run_build_count() - self._builds0
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def stats(self) -> dict:
+        return {"buckets": len(self._sims), "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": round(self.hit_rate, 4),
+                "builds": self.builds}

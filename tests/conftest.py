@@ -44,6 +44,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: bench-scale validation runs (deselect with "
         "-m 'not slow' while iterating)")
+    config.addinivalue_line(
+        "markers", "service: serving-layer tests (select the fast "
+        "service path with -m service; the full mixed-trace replay is "
+        "additionally marked slow and runs outside tier-1)")
 
 
 @pytest.fixture(scope="session")
