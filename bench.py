@@ -505,23 +505,61 @@ def main():
         from gossip_protocol_tpu.service import (grader_templates,
                                                  overlay_templates)
         from gossip_protocol_tpu.service import replay as service_replay
+
+        def _sv_entry(sv: dict) -> dict:
+            return {
+                "requests": sv["requests"],
+                "devices": sv["devices"],
+                "speedup_vs_sequential": sv["speedup_vs_sequential"],
+                "aggregate_node_ticks_per_s":
+                    sv["aggregate_node_ticks_per_s"],
+                "latency_p50_s": sv["latency_p50_s"],
+                "latency_p95_s": sv["latency_p95_s"],
+                "mean_occupancy": sv["mean_occupancy"],
+                "device_wait_frac": sv["device_wait_frac"],
+                "cache_hit_rate": sv["cache_hit_rate"],
+                "buckets": sv["buckets"],
+                "max_builds_per_bucket": sv["max_builds_per_bucket"],
+            }
+
         n_sv, t_sv, seeds_sv = (256, 48, 2) if smoke else (512, 96, 8)
+        sv_templates = grader_templates() + overlay_templates(n=n_sv,
+                                                              ticks=t_sv)
         # batch width must fit the stream: padding 2-seed smoke
         # buckets to 8 lanes would be 75% filler work
-        sv = service_replay(
-            grader_templates() + overlay_templates(n=n_sv, ticks=t_sv),
-            seeds_per_template=seeds_sv, max_batch=min(8, 2 * seeds_sv))
-        secondary["service_replay_mixed"] = {
-            "requests": sv["requests"],
-            "speedup_vs_sequential": sv["speedup_vs_sequential"],
-            "aggregate_node_ticks_per_s": sv["aggregate_node_ticks_per_s"],
-            "latency_p50_s": sv["latency_p50_s"],
-            "latency_p95_s": sv["latency_p95_s"],
-            "mean_occupancy": sv["mean_occupancy"],
-            "cache_hit_rate": sv["cache_hit_rate"],
-            "buckets": sv["buckets"],
-            "max_builds_per_bucket": sv["max_builds_per_bucket"],
-        }
+        sv_lanes = min(8, 2 * seeds_sv)
+        sv, seq_leg = service_replay(sv_templates,
+                                     seeds_per_template=seeds_sv,
+                                     max_batch=sv_lanes, return_legs=True)
+        secondary["service_replay_mixed"] = _sv_entry(sv)
+
+        import jax
+        if jax.device_count() > 1:
+            # lane-mesh serving (parallel/fleet_mesh.py) at EQUAL total
+            # lane width: max_batch is per-device and d must DIVIDE
+            # sv_lanes (largest divisor within the live device count),
+            # so the mesh replay dispatches exactly the same sv_lanes
+            # lanes split over the mesh — on a device count that does
+            # not divide the width, a smaller mesh keeps the
+            # comparison honest rather than silently changing the
+            # width.  The sequential baseline is identical by
+            # construction, so the first replay's leg is reused
+            # (parity is still verified against it per request).
+            # Reachable when the invoker forced virtual devices
+            # (XLA_FLAGS=--xla_force_host_platform_device_count=N) —
+            # recorded in this json's "env" metadata.
+            from gossip_protocol_tpu.parallel.fleet_mesh import \
+                make_lane_mesh
+            d = max(k for k in range(1, min(jax.device_count(),
+                                            sv_lanes) + 1)
+                    if sv_lanes % k == 0)
+            if d > 1:
+                sv_m = service_replay(sv_templates,
+                                      seeds_per_template=seeds_sv,
+                                      max_batch=sv_lanes // d,
+                                      mesh=make_lane_mesh(d),
+                                      sequential=seq_leg)
+                secondary["service_replay_mixed_mesh"] = _sv_entry(sv_m)
 
     secondary.update({
         f"n{n_drop}_overlay_drop10": _overlay_entry(drop, backend),
@@ -556,6 +594,12 @@ def main():
         secondary["overlay_powerlaw_1m_vs_baseline"] = round(
             pl_1m.node_ticks_per_second / REFERENCE_NODE_TICKS_PER_S, 3)
 
+    # provenance: every BENCH json must say what machine shape produced
+    # it — the mesh numbers are meaningless without the live (virtual)
+    # device count and the XLA flags that forced it
+    import os
+
+    import jax
     nps = overlay.node_ticks_per_second
     print(json.dumps({
         "metric": f"node_ticks_per_s_n{n_overlay}_overlay_churn20",
@@ -564,6 +608,13 @@ def main():
         "vs_baseline": round(nps / REFERENCE_NODE_TICKS_PER_S, 3),
         "backend": backend,
         "ticks_per_s": round(nps / n_overlay, 1),
+        "env": {
+            "device_count": jax.device_count(),
+            "jax_backend": jax.default_backend(),
+            "devices": [str(d) for d in jax.devices()[:2]]
+            + (["..."] if jax.device_count() > 2 else []),
+            "xla_flags": os.environ.get("XLA_FLAGS", ""),
+        },
         "headline": _overlay_entry(overlay, backend),
         "secondary": secondary,
     }))

@@ -191,10 +191,12 @@ def _shape_mismatch(fleet_cfg: SimConfig, lane_cfg: SimConfig) -> str:
 
 #: Compiled fleet programs, shared across FleetSimulation instances
 #: (exactly like core/tick._RUN_CACHE for single runs).  Keys carry
-#: the fleet shape key, the segment-plan signature, and the batch
-#: geometry; misses are counted through core.tick.note_build so the
-#: serving layer's "one build per distinct bucket key" contract is a
-#: run_build_count delta.
+#: the fleet shape key, the segment-plan signature, the MESH slot
+#: (None on the single-device path; the lane-mesh descriptor on
+#: parallel/fleet_mesh.py's — a device-count change can never be
+#: served a stale program), and the batch geometry; misses are
+#: counted through core.tick.note_build so the serving layer's "one
+#: build per distinct bucket key" contract is a run_build_count delta.
 _FLEET_FN_CACHE: dict = {}
 
 
@@ -228,6 +230,12 @@ class FleetResult:
     #: compiled batch width actually dispatched (>= len(lanes) when
     #: filler lanes padded a partial batch; 0 = no padding happened)
     padded_batch: int = 0
+    #: seconds of ``wall_seconds`` spent waiting on the device program
+    #: (dispatch + block_until_ready); the remainder is host-side
+    #: stack/unstack work.  The serving layer splits its per-dispatch
+    #: wall on this so mesh speedups land in the right column
+    #: (FleetService.stats).
+    device_seconds: float = 0.0
 
     @property
     def batch(self) -> int:
@@ -293,6 +301,15 @@ class FleetSimulation:
         self.cfg = cfg
         self.block_size = block_size
         self.chunk_ticks = chunk_ticks
+        # every _FLEET_FN_CACHE key this instance touched, so
+        # evict_programs() drops exactly this bucket's programs — a
+        # prefix match would also hit sibling buckets that share the
+        # shape but differ in mode or drop probability
+        self._program_keys: set = set()
+
+    def _fleet_program(self, key, builder):
+        self._program_keys.add(key)
+        return _fleet_fn(key, builder)
 
     @staticmethod
     def _resolve_n_real(batch: int, n_real) -> int:
@@ -324,10 +341,53 @@ class FleetSimulation:
         return configs
 
     # ---- shared program cache ---------------------------------------
-    def _cache_key(self, *extra):
+    def _mesh_entry(self):
+        """The mesh slot of this fleet's program-cache keys.
+
+        ``None`` here (single-device); parallel/fleet_mesh.py overrides
+        with the lane-mesh descriptor, so a device-count change is a
+        different key — never a stale program.
+        """
+        return None
+
+    def _key_prefix(self) -> tuple:
         from ..models.segments import plan_signature
         return (fleet_shape_key(self.cfg), plan_signature(self.cfg),
-                self.block_size) + extra
+                self.block_size, self._mesh_entry())
+
+    def _cache_key(self, *extra):
+        return self._key_prefix() + extra
+
+    def evict_programs(self) -> int:
+        """Drop this handle's compiled programs from the process
+        caches; returns how many were evicted.
+
+        The serving layer's bounded ProgramCache calls this on LRU
+        eviction so dropping a bucket handle actually frees its jitted
+        executables rather than just the thin FleetSimulation wrapper.
+        ``_FLEET_FN_CACHE`` eviction is exact — only keys THIS
+        instance touched, so a sibling bucket sharing the shape (other
+        mode, other drop probability) keeps its programs.  The
+        single-device overlay path compiles through
+        ``_OVERLAY_FLEET_CACHE`` instead, whose keys this class cannot
+        enumerate per-instance; those are purged by seed-stripped
+        config, which may also evict a mode-sibling overlay bucket of
+        the identical config — one redundant rebuild, never a
+        correctness issue.
+        """
+        n = 0
+        for k in self._program_keys:
+            if _FLEET_FN_CACHE.pop(k, None) is not None:
+                n += 1
+        self._program_keys.clear()
+        if self.cfg.model == "overlay" and self._mesh_entry() is None:
+            from ..models.overlay import _OVERLAY_FLEET_CACHE
+            shape = self.cfg.replace(seed=0)
+            stale = [k for k in _OVERLAY_FLEET_CACHE if k[0] == shape]
+            for k in stale:
+                del _OVERLAY_FLEET_CACHE[k]
+            n += len(stale)
+        return n
 
     # ---- dense bench ------------------------------------------------
     def _dense_bench_fn(self, batch: int, width: int, shared_drop: bool):
@@ -350,7 +410,7 @@ class FleetSimulation:
 
             return run
 
-        return _fleet_fn(self._cache_key("bench", batch, width,
+        return self._fleet_program(self._cache_key("bench", batch, width,
                                          shared_drop), build)
 
     def run_bench(self, seeds=None, configs=None, warmup: bool = True,
@@ -404,8 +464,11 @@ class FleetSimulation:
             f, _ = run(fresh_states(), sscheds)
             jax.block_until_ready(f.known)
         t0 = time.perf_counter()
-        final, (sent, recv) = run(fresh_states(), sscheds)
+        states0 = fresh_states()
+        t_dev0 = time.perf_counter()
+        final, (sent, recv) = run(states0, sscheds)
         jax.block_until_ready(final.known)
+        t_dev = time.perf_counter() - t_dev0
         if int(np.asarray(final.tick)) != total:
             raise RuntimeError("fleet bench did not complete all ticks")
         wall = time.perf_counter() - t0
@@ -431,7 +494,8 @@ class FleetSimulation:
                 counter_stream_width=bench_stream_width(c),
             ))
         return FleetResult(lanes=lanes, wall_seconds=wall,
-                           padded_batch=len(cfgs) if nr < len(cfgs) else 0)
+                           padded_batch=len(cfgs) if nr < len(cfgs) else 0,
+                           device_seconds=t_dev)
 
     # ---- dense trace -------------------------------------------------
     def _dense_trace_fn(self, batch: int, length: int, shared_drop: bool):
@@ -451,7 +515,7 @@ class FleetSimulation:
 
             return run
 
-        return _fleet_fn(self._cache_key("trace", batch, length,
+        return self._fleet_program(self._cache_key("trace", batch, length,
                                          shared_drop), build)
 
     def run(self, seeds=None, configs=None, n_real: Optional[int] = None,
@@ -486,11 +550,15 @@ class FleetSimulation:
         states = _stack_states([init_state(c) for c in cfgs])
         added, removed, sent, recv = [], [], [], []
         t0 = time.perf_counter()
+        t_dev = 0.0
         done = 0
         while done < total:
             length = min(chunk, total - done)
             run = self._dense_trace_fn(b, length, shared)
+            t_dev0 = time.perf_counter()
             states, ev = run(states, sscheds)
+            jax.block_until_ready(states.tick)
+            t_dev += time.perf_counter() - t_dev0
             # one sparse compaction for the whole (length*n_real, N, N)
             # stack — filler lanes are sliced off ON DEVICE first, so
             # their events can neither inflate the sparse budget nor
@@ -528,18 +596,25 @@ class FleetSimulation:
                 wall_seconds=wall,
             ))
         return FleetResult(lanes=lanes, wall_seconds=wall,
-                           padded_batch=b if nr < b else 0)
+                           padded_batch=b if nr < b else 0,
+                           device_seconds=t_dev)
+
+    def _overlay_fleet_fn(self, batch: int):
+        """The overlay fleet's compiled program (the mesh subclass in
+        parallel/fleet_mesh.py overrides this with the lane-sharded
+        build)."""
+        from ..models.overlay import make_overlay_fleet_run
+        return make_overlay_fleet_run(self.cfg, batch)
 
     # ---- overlay (metrics mode) --------------------------------------
     def _overlay_fleet(self, cfgs: Sequence[SimConfig], warmup: bool,
                        n_real: Optional[int] = None) -> FleetResult:
         from ..models.overlay import (OverlayResult, init_overlay_state,
-                                      make_overlay_fleet_run,
                                       make_overlay_schedule)
         b = len(cfgs)
         nr = self._resolve_n_real(b, n_real)
         total = self.cfg.total_ticks
-        run = make_overlay_fleet_run(self.cfg, b)
+        run = self._overlay_fleet_fn(b)
         scheds = [make_overlay_schedule(c) for c in cfgs]
         sscheds = stack_lanes(scheds)
 
@@ -550,8 +625,11 @@ class FleetSimulation:
             f, _ = run(fresh_states(), sscheds)
             jax.block_until_ready(f.ids)
         t0 = time.perf_counter()
-        final, metrics = run(fresh_states(), sscheds)
+        states0 = fresh_states()
+        t_dev0 = time.perf_counter()
+        final, metrics = run(states0, sscheds)
         jax.block_until_ready(final.ids)
+        t_dev = time.perf_counter() - t_dev0
         if int(np.asarray(final.tick)) != total:
             raise RuntimeError("fleet overlay run did not complete")
         wall = time.perf_counter() - t0
@@ -565,4 +643,5 @@ class FleetSimulation:
             wall_seconds=wall,
         ) for i, c in enumerate(cfgs[:nr])]
         return FleetResult(lanes=lanes, wall_seconds=wall,
-                           padded_batch=b if nr < b else 0)
+                           padded_batch=b if nr < b else 0,
+                           device_seconds=t_dev)

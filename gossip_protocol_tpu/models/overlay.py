@@ -1041,6 +1041,17 @@ def make_overlay_run(cfg: SimConfig, length: int | None = None,
 
 _OVERLAY_FLEET_CACHE: dict = {}
 
+#: vmap axes of a stacked overlay fleet: every lane carries its own
+#: arrays but the CLOCK is shared (``tick=None``), exactly like
+#: core/fleet.WORLD_AXES — the lane-mesh path
+#: (parallel/fleet_mesh.py) derives its replicated-vs-sharded
+#: PartitionSpecs from this tree, so the two stay in lockstep by
+#: construction.
+OVERLAY_FLEET_STATE_AXES = OverlayState(tick=None, ids=0, hb=0, ts=0,
+                                        in_group=0, own_hb=0,
+                                        send_flags=0, joinreq=0,
+                                        joinrep=0)
+
 
 def make_overlay_fleet_run(cfg: SimConfig, batch: int,
                            length: int | None = None,
@@ -1091,9 +1102,7 @@ def make_overlay_fleet_run(cfg: SimConfig, batch: int,
         _OVERLAY_FLEET_CACHE[key] = run
         return run
     tick = make_overlay_tick(cfg, use_pallas=False, with_coverage=False)
-    state_axes = OverlayState(tick=None, ids=0, hb=0, ts=0, in_group=0,
-                              own_hb=0, send_flags=0, joinreq=0,
-                              joinrep=0)
+    state_axes = OVERLAY_FLEET_STATE_AXES
     vtick = jax.vmap(tick, in_axes=(state_axes, 0),
                      out_axes=(state_axes, 0))
 

@@ -1,0 +1,271 @@
+"""Mesh-parallel fleet: the lane axis sharded over a device mesh.
+
+parallel/sharded.py scales ONE simulation out by sharding the peer
+axis — and pays an ``all_to_all`` plus a ``ppermute`` ring every tick
+for it (docs/PERF.md §4).  The fleet's LANE axis (core/fleet.py) is
+the opposite kind of parallel: B independent simulations share only
+the unbatched clock and (within a bucket) the drop plan, so sharding
+lanes over a 1-D mesh costs ZERO collectives per tick — it is plain
+data parallelism, the same shape a training stack gives its batch
+axis under GSPMD/pjit, and the capacity axis an Orca-style
+continuous-batching server schedules against.
+
+Why it pays even on one host: the kernels are op-*issue*-bound
+(PERF §3, §8) — the machine spends its time issuing per-tick ops, not
+computing them — and a vmapped fleet still issues every op from ONE
+program stream, which is exactly why the single-device fleet curve
+flattens near B≈8–16.  ``shard_map`` over D devices gives D
+concurrent program streams (XLA:CPU executes each shard's partition
+on its own dispatch thread; on TPU each chip runs its own program),
+attacking the issue bottleneck the vmap lever cannot reach.
+
+Shape of the thing (``MeshFleetSimulation`` — a drop-in
+:class:`~..core.fleet.FleetSimulation` with a mesh):
+
+* **Lane-sharded stacks.**  States and schedules are stacked exactly
+  as in core/fleet.py, then placed with ``NamedSharding``: every
+  lane-batched leaf is split over ``LANE_AXIS``; each shard runs the
+  same vmapped scan over its B/D local lanes inside one ``shard_map``
+  (donated carry, one jitted program).
+* **The clock and the drop plane are REPLICATED.**  The replicated
+  set is *definitionally* the unbatched set: PartitionSpecs are
+  derived from the fleet's vmap axes trees (``WORLD_AXES``,
+  ``SCHED_AXES_SHARED_DROP``), so the PR-3 shared-drop rule survives
+  sharding by construction.  This is load-bearing the same way it was
+  under vmap: a per-shard (or per-lane) ``drop_active`` would
+  re-degrade the drop ``lax.cond`` to a both-branches select —
+  pinned by tests/test_fleet_mesh.py's jaxpr regression.
+* **Bit-identical lanes.**  A lane's trajectory is integer/bool/PRNG
+  arithmetic with no cross-lane reduction, so mesh lanes replay
+  single-device fleet lanes — and solo runs — bit-for-bit
+  (tests/test_fleet_mesh.py, D ∈ {2, 4, 8} virtual CPU devices).
+* **Batch must divide the mesh.**  ``B % D == 0`` is enforced with an
+  actionable error; the serving layer pads dispatches to a
+  shard-divisible width (service/scheduler.py ``pad_policy`` × mesh
+  factor).
+
+Compiled programs live in the process-wide ``_FLEET_FN_CACHE`` with
+the mesh descriptor in the key (core/fleet.py ``_mesh_entry``): a
+device-count change can never be served a stale program.
+
+On a TPU pod this lane mesh composes with §4's peer sharding as a
+2-D mesh (lanes × peers): the per-tick collectives stay *within* each
+lane's peer-axis submesh, and the lane axis still moves zero bytes.
+The 2-D path is documented (PERF §10), not shipped — there is no
+hardware here to validate it on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..compat.jaxapi import shard_map
+from ..config import SimConfig
+from ..core.fleet import (EVENT_AXES, SCHED_AXES_BATCHED,
+                          SCHED_AXES_SHARED_DROP, WORLD_AXES,
+                          FleetSimulation)
+from ..core.tick import TickEvents, make_tick
+
+LANE_AXIS = "lanes"
+
+
+def make_lane_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D lane mesh over the first ``n_devices`` available devices."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"asked for a {n_devices}-device lane mesh but only "
+                f"{len(devs)} devices are available "
+                f"(backend={jax.default_backend()}; CPU runs force "
+                "virtual devices via "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                "before jax is first imported)")
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (LANE_AXIS,))
+
+
+def mesh_descriptor(mesh: Mesh) -> tuple:
+    """Hashable identity of a lane mesh for program-cache keys."""
+    return (mesh.axis_names, tuple(d.id for d in mesh.devices.flat))
+
+
+def _axes_to_specs(axes):
+    """vmap axes tree -> PartitionSpec tree: batched leaves are
+    lane-sharded, unbatched leaves (the clock, the shared drop plane)
+    are replicated.  Deriving specs from the axes tree keeps the
+    replicated set identical to the unbatched set by construction."""
+    cls = type(axes)
+    return cls(**{f.name: (P() if getattr(axes, f.name) is None
+                           else P(LANE_AXIS))
+                  for f in dataclasses.fields(cls)})
+
+
+def _all_lane_specs(cls):
+    """Every field of ``cls`` lane-sharded on its leading axis."""
+    return cls(**{f.name: P(LANE_AXIS) for f in dataclasses.fields(cls)})
+
+
+def _place(tree, specs, mesh: Mesh):
+    """Put a stacked pytree onto the mesh with the given specs."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        tree, specs)
+
+
+class MeshFleetSimulation(FleetSimulation):
+    """:class:`~..core.fleet.FleetSimulation` with the lane axis
+    sharded over a 1-D device mesh.
+
+    Same API and same per-lane results (bit-identical) as the
+    single-device fleet; the batch must be a multiple of the mesh
+    size.  ``run``/``run_bench`` accept the same ``seeds=``/
+    ``configs=``/``n_real=`` arguments — the serving layer drives
+    this class through the unchanged scheduler with shard-divisible
+    padding (service/scheduler.py ``mesh=``).
+    """
+
+    def __init__(self, cfg: SimConfig, mesh: Optional[Mesh] = None,
+                 block_size: int = 128,
+                 chunk_ticks: Optional[int] = None):
+        super().__init__(cfg, block_size=block_size,
+                         chunk_ticks=chunk_ticks)
+        self.mesh = mesh if mesh is not None else make_lane_mesh()
+        if self.mesh.devices.ndim != 1 or len(self.mesh.axis_names) != 1:
+            raise ValueError(
+                f"MeshFleetSimulation takes a 1-D lane mesh, got axes "
+                f"{self.mesh.axis_names} shape {self.mesh.devices.shape}")
+
+    @property
+    def n_devices(self) -> int:
+        return self.mesh.devices.size
+
+    # ---- program-cache identity -------------------------------------
+    def _mesh_entry(self):
+        return mesh_descriptor(self.mesh)
+
+    # ---- lane validation --------------------------------------------
+    def _lane_cfgs(self, seeds, configs):
+        cfgs = super()._lane_cfgs(seeds, configs)
+        d = self.n_devices
+        if len(cfgs) % d:
+            raise ValueError(
+                f"fleet of {len(cfgs)} lanes does not divide over the "
+                f"{d}-device {LANE_AXIS!r} mesh; pad to a multiple of "
+                f"{d} (the serving layer's pad policies do this — "
+                "service/scheduler.py)")
+        return cfgs
+
+    # ---- shared build plumbing --------------------------------------
+    def _shard_run(self, body, state_specs, sched_specs, out_specs):
+        """jit(shard_map(body)) with the carry donated, wrapped so the
+        stacked host inputs are placed with the canonical shardings on
+        every call.  The raw jitted program is exposed as ``.jitted``
+        for the drop-plane jaxpr regression (tests/test_fleet_mesh.py).
+        """
+        mesh = self.mesh
+        shmapped = shard_map(body, mesh=mesh,
+                             in_specs=(state_specs, sched_specs),
+                             out_specs=out_specs)
+        jitted = jax.jit(shmapped, donate_argnums=(0,))
+
+        def run(states, scheds):
+            return jitted(_place(states, state_specs, mesh),
+                          _place(scheds, sched_specs, mesh))
+
+        run.jitted = jitted
+        return run
+
+    # ---- dense bench ------------------------------------------------
+    def _dense_bench_fn(self, batch: int, width: int, shared_drop: bool):
+        def build():
+            cfg_w = self.cfg.replace(max_nnb=width)
+            tick = make_tick(cfg_w, self.block_size, use_pallas=False,
+                             with_events=False)
+            axes = SCHED_AXES_SHARED_DROP if shared_drop \
+                else SCHED_AXES_BATCHED
+            vtick = jax.vmap(tick, in_axes=(WORLD_AXES, axes),
+                             out_axes=(WORLD_AXES, EVENT_AXES))
+            total = self.cfg.total_ticks
+
+            def body(states, scheds):
+                def step(carry, _):
+                    carry, ev = vtick(carry, scheds)
+                    return carry, (ev.sent, ev.recv)
+                return jax.lax.scan(step, states, None, length=total)
+
+            state_specs = _axes_to_specs(WORLD_AXES)
+            # scan stacks ticks leading: (T, B, width) counters
+            cnt = P(None, LANE_AXIS)
+            return self._shard_run(body, state_specs,
+                                   _axes_to_specs(axes),
+                                   (state_specs, (cnt, cnt)))
+
+        return self._fleet_program(self._cache_key("bench", batch, width,
+                                         shared_drop), build)
+
+    # ---- dense trace -------------------------------------------------
+    def _dense_trace_fn(self, batch: int, length: int, shared_drop: bool):
+        def build():
+            tick = make_tick(self.cfg, self.block_size, use_pallas=False,
+                             with_events=True)
+            axes = SCHED_AXES_SHARED_DROP if shared_drop \
+                else SCHED_AXES_BATCHED
+            vtick = jax.vmap(tick, in_axes=(WORLD_AXES, axes),
+                             out_axes=(WORLD_AXES, EVENT_AXES))
+
+            def body(states, scheds):
+                def step(carry, _):
+                    return vtick(carry, scheds)
+                return jax.lax.scan(step, states, None, length=length)
+
+            state_specs = _axes_to_specs(WORLD_AXES)
+            ev = P(None, LANE_AXIS)        # (T, B, ...) event stacks
+            ev_specs = TickEvents(added=ev, removed=ev, sent=ev, recv=ev)
+            return self._shard_run(body, state_specs,
+                                   _axes_to_specs(axes),
+                                   (state_specs, ev_specs))
+
+        return self._fleet_program(self._cache_key("trace", batch, length,
+                                         shared_drop), build)
+
+    # ---- overlay (metrics mode) --------------------------------------
+    def _overlay_fleet_fn(self, batch: int):
+        from ..models.overlay import (OVERLAY_FLEET_STATE_AXES,
+                                      OverlayMetrics, OverlaySchedule,
+                                      make_overlay_tick)
+        length = self.cfg.total_ticks
+
+        def build():
+            # the pure-XLA tick, coverage elided — identical routing to
+            # make_overlay_fleet_run's vmap path; the TPU grid kernel's
+            # leading batch grid dimension does not shard_map (Mosaic
+            # owns its own grid), so a TPU lane mesh would run the
+            # SAME per-shard grid fleet — documented in PERF §10, not
+            # compiled here (no hardware to validate on)
+            tick = make_overlay_tick(self.cfg, use_pallas=False,
+                                     with_coverage=False)
+            state_axes = OVERLAY_FLEET_STATE_AXES
+            vtick = jax.vmap(tick, in_axes=(state_axes, 0),
+                             out_axes=(state_axes, 0))
+
+            def body(states, scheds):
+                def step(carry, _):
+                    return vtick(carry, scheds)
+                finals, mets = jax.lax.scan(step, states, None,
+                                            length=length)
+                # (T, B) per-tick counters -> the (B, T) fleet contract
+                return finals, jax.tree.map(lambda m: m.T, mets)
+
+            state_specs = _axes_to_specs(state_axes)
+            return self._shard_run(body, state_specs,
+                                   _all_lane_specs(OverlaySchedule),
+                                   (state_specs,
+                                    _all_lane_specs(OverlayMetrics)))
+
+        return self._fleet_program(self._cache_key("overlay", batch, length), build)

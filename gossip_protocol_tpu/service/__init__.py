@@ -6,7 +6,10 @@ them by compiled-shape compatibility (shape key + segment-plan
 signature), pad partial batches with inert filler lanes, and serve
 each bucket through one cached compiled fleet program — per-request
 results bit-identical to solo runs, with per-request latency and
-per-dispatch occupancy metrics.  See docs/SERVING.md.
+per-dispatch occupancy metrics.  With ``mesh=`` (a lane mesh,
+parallel/fleet_mesh.py) every dispatch is served from the whole
+mesh: capacity ``max_batch x n_devices``, shard-divisible padding,
+mesh-keyed program caches.  See docs/SERVING.md.
 """
 
 from .bucket import bucket_key, pad_configs

@@ -2,17 +2,37 @@
 
 The expensive artifacts — jitted whole-fleet programs — already live
 in the process-wide ``core.fleet._FLEET_FN_CACHE`` keyed by (shape
-key, segment-plan signature, batch geometry), and every build there
-moves ``core.tick.run_build_count``.  This cache adds the serving
-view of the same thing: bucket key -> the FleetSimulation handle that
-owns the bucket's dispatches, plus hit/miss/build counters so the
-scheduler can report cache behavior per dispatch ("a 20-request mixed
-trace builds at most once per distinct bucket key",
+key, segment-plan signature, mesh slot, batch geometry), and every
+build there moves ``core.tick.run_build_count``.  This cache adds the
+serving view of the same thing: bucket key -> the FleetSimulation
+handle that owns the bucket's dispatches, plus hit/miss/build
+counters so the scheduler can report cache behavior per dispatch ("a
+20-request mixed trace builds at most once per distinct bucket key",
 tests/test_service.py::test_mixed_trace_builds_once_per_bucket).
+
+Two serving-scale concerns live here rather than in core/fleet.py:
+
+* **Mesh identity.**  A cache constructed over a lane mesh
+  (parallel/fleet_mesh.py) hands out
+  :class:`~..parallel.fleet_mesh.MeshFleetSimulation` handles, whose
+  compiled programs carry the mesh descriptor in the process-wide
+  ``_FLEET_FN_CACHE`` keys — a device-count change can never be
+  served a stale single-device (or wrong-width) program
+  (tests/test_service.py::test_mesh_device_count_misses_program_cache).
+* **A bound.**  Bucket keys multiply under a mesh sweep (same shapes
+  x device counts) and under long heterogeneous streams, and each
+  bucket pins jitted executables.  ``max_entries`` bounds the cache
+  with LRU eviction; evicting a bucket also drops its compiled
+  programs from the process caches (``FleetSimulation.
+  evict_programs``), so the bound frees real memory, not just the
+  thin handle.  Note the process caches are shared: evicting a shape
+  another driver (e.g. the grader) still uses costs that driver one
+  rebuild — correctness is never affected.
 """
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Optional
 
 from ..config import SimConfig
@@ -21,31 +41,60 @@ from ..core.tick import run_build_count
 
 
 class ProgramCache:
-    """bucket key -> :class:`~..core.fleet.FleetSimulation`."""
+    """bucket key -> :class:`~..core.fleet.FleetSimulation` (or the
+    mesh subclass when constructed with ``mesh=``), LRU-bounded."""
 
     def __init__(self, block_size: int = 128,
-                 chunk_ticks: Optional[int] = None):
+                 chunk_ticks: Optional[int] = None, mesh=None,
+                 max_entries: Optional[int] = 64):
+        if max_entries is not None and max_entries < 1:
+            raise ValueError(f"max_entries must be >= 1 or None, "
+                             f"got {max_entries}")
         self._block_size = block_size
         self._chunk_ticks = chunk_ticks
-        self._sims: dict = {}
+        self._mesh = mesh
+        self.max_entries = max_entries
+        self._sims: OrderedDict = OrderedDict()
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
         self._builds0 = run_build_count()
+
+    def _make_sim(self, cfg: SimConfig) -> FleetSimulation:
+        if self._mesh is not None:
+            from ..parallel.fleet_mesh import MeshFleetSimulation
+            return MeshFleetSimulation(cfg, self._mesh,
+                                       block_size=self._block_size,
+                                       chunk_ticks=self._chunk_ticks)
+        return FleetSimulation(cfg, block_size=self._block_size,
+                               chunk_ticks=self._chunk_ticks)
 
     def get(self, key: tuple, cfg: SimConfig) -> FleetSimulation:
         """The bucket's fleet handle (created on first use).
 
         ``cfg`` seeds the handle's shape on a miss; later calls with
-        any same-bucket config return the same handle.
+        any same-bucket config return the same handle.  Entries are
+        touched LRU-wise; inserting past ``max_entries`` evicts the
+        least recently used bucket AND its compiled programs.  The
+        cache's mesh is fixed at construction (one service, one mesh),
+        so the bucket key alone identifies an entry here; cross-mesh
+        staleness is impossible anyway because the handles' compiled
+        programs carry the mesh slot in their own process-cache keys
+        (core/fleet.py ``_mesh_entry``).
         """
         sim = self._sims.get(key)
         if sim is None:
             self.misses += 1
-            sim = FleetSimulation(cfg, block_size=self._block_size,
-                                  chunk_ticks=self._chunk_ticks)
+            sim = self._make_sim(cfg)
             self._sims[key] = sim
+            if self.max_entries is not None \
+                    and len(self._sims) > self.max_entries:
+                _, old = self._sims.popitem(last=False)
+                old.evict_programs()
+                self.evictions += 1
         else:
             self.hits += 1
+            self._sims.move_to_end(key)
         return sim
 
     @property
@@ -68,4 +117,8 @@ class ProgramCache:
         return {"buckets": len(self._sims), "hits": self.hits,
                 "misses": self.misses,
                 "hit_rate": round(self.hit_rate, 4),
-                "builds": self.builds}
+                "builds": self.builds,
+                "evictions": self.evictions,
+                "max_entries": self.max_entries,
+                "devices": (self._mesh.devices.size
+                            if self._mesh is not None else 1)}

@@ -193,16 +193,39 @@ def node_ticks(trace) -> int:
 
 
 def replay(templates: list[Template], seeds_per_template: int,
-           max_batch: int = 8, check_parity: bool = True) -> dict:
+           max_batch: int = 8, check_parity: bool = True,
+           mesh=None, sequential=None, return_legs: bool = False):
     """Full A/B replay; returns the service-metrics dict for BENCH.
 
     Raises on any per-request parity mismatch — a serving layer that
     changes results has no throughput to report.
+
+    ``mesh`` serves the stream from a lane mesh
+    (parallel/fleet_mesh.py): ``max_batch`` is then the PER-DEVICE
+    lane width, so pass ``max_batch = total_lanes // n_devices`` to
+    compare device counts at equal total lane width (the PERF §10
+    curve).
+
+    The sequential baseline of one trace is the same however the
+    service side is configured, so a caller comparing several service
+    configurations (device counts, batch widths) can run it once:
+    ``return_legs=True`` additionally returns ``(seq_results,
+    seq_wall)``, and ``sequential=`` feeds that pair back in place of
+    a fresh baseline run — parity is still verified per request
+    against it.
     """
     trace = build_trace(templates, seeds_per_template)
-    svc = FleetService(max_batch=max_batch)
+    svc = FleetService(max_batch=max_batch, mesh=mesh)
     warm(trace, svc)
-    seq_results, seq_wall = run_sequential(trace)
+    if sequential is None:
+        seq_results, seq_wall = run_sequential(trace)
+    else:
+        seq_results, seq_wall = sequential
+        if len(seq_results) != len(trace):
+            raise ValueError(
+                f"sequential= leg has {len(seq_results)} results but "
+                f"the trace has {len(trace)} requests; both replays "
+                "must use the same templates and seeds_per_template")
     svc_results, svc, svc_wall = run_service(trace, service=svc)
     if check_parity:
         bad = verify_parity(trace, seq_results, svc_results)
@@ -216,9 +239,11 @@ def replay(templates: list[Template], seeds_per_template: int,
     # cache's own ``builds`` is a process-wide delta that also counts
     # the sequential leg's solo compilations
     per_bucket_builds = [b["builds"] for b in stats["buckets"].values()]
-    return {
+    metrics = {
         "requests": len(trace),
         "distinct_templates": len(templates),
+        "devices": stats["devices"],
+        "capacity": stats["capacity"],
         "sequential_wall_s": round(seq_wall, 3),
         "service_wall_s": round(svc_wall, 3),
         "speedup_vs_sequential": round(seq_wall / svc_wall, 2),
@@ -227,6 +252,9 @@ def replay(templates: list[Template], seeds_per_template: int,
         "latency_p50_s": stats["latency_p50_s"],
         "latency_p95_s": stats["latency_p95_s"],
         "mean_occupancy": stats["mean_occupancy"],
+        "mean_device_wait_s": stats["mean_device_wait_s"],
+        "mean_host_s": stats["mean_host_s"],
+        "device_wait_frac": stats["device_wait_frac"],
         # compiled-program reuse per dispatch (zero new builds) — the
         # honest cache metric; ProgramCache.hit_rate only counts
         # bucket-handle reuse
@@ -237,3 +265,6 @@ def replay(templates: list[Template], seeds_per_template: int,
         "dispatches": stats["dispatches"],
         "parity_checked": bool(check_parity),
     }
+    if return_legs:
+        return metrics, (seq_results, seq_wall)
+    return metrics

@@ -71,13 +71,23 @@ class FleetService:
     ``max_wait_s`` bounds queueing latency under trickle traffic; it
     is enforced cooperatively (checked on every ``submit``/``pump``
     against ``clock()``), not by a background thread.
+
+    ``mesh`` (a 1-D lane mesh, ``parallel.fleet_mesh.make_lane_mesh``)
+    serves every dispatch from the whole mesh: ``max_batch`` becomes
+    the PER-DEVICE lane width and the dispatch :attr:`capacity` is
+    ``max_batch x n_devices``; pad widths are rounded up to a
+    shard-divisible lane count (every pad policy, so a partial batch
+    always divides over the mesh), and the program cache keys gain the
+    mesh descriptor so a device-count change can never be served a
+    stale program.
     """
 
     def __init__(self, max_batch: int = 8,
                  max_wait_s: Optional[float] = None,
                  pad_policy: str = "full", block_size: int = 128,
                  chunk_ticks: Optional[int] = None, clock=time.perf_counter,
-                 stats_window: int = 1 << 14):
+                 stats_window: int = 1 << 14, mesh=None,
+                 cache_max_entries: Optional[int] = 64):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if pad_policy not in PAD_POLICIES:
@@ -86,9 +96,12 @@ class FleetService:
         self.max_batch = max_batch
         self.max_wait_s = max_wait_s
         self.pad_policy = pad_policy
+        self.mesh = mesh
+        self.n_devices = int(mesh.devices.size) if mesh is not None else 1
         self.clock = clock
         self.cache = ProgramCache(block_size=block_size,
-                                  chunk_ticks=chunk_ticks)
+                                  chunk_ticks=chunk_ticks, mesh=mesh,
+                                  max_entries=cache_max_entries)
         self._queues: dict[tuple, deque] = {}
         self._handles: dict[int, RequestHandle] = {}
         self._filler: dict[tuple, SimConfig] = {}
@@ -131,18 +144,24 @@ class FleetService:
         self.pump()
         return handle
 
+    @property
+    def capacity(self) -> int:
+        """Lanes one dispatch can carry: ``max_batch`` per device,
+        times the lane mesh (1 without a mesh)."""
+        return self.max_batch * self.n_devices
+
     # ---- flush policies ----------------------------------------------
     def pump(self) -> int:
         """One cooperative scheduling pass; returns dispatches made.
 
-        Flushes every bucket that is full (``max_batch``) and every
+        Flushes every bucket that is full (:attr:`capacity`) and every
         bucket whose oldest request has waited past ``max_wait_s``.
         """
         n = 0
         now = self.clock()
         for key in list(self._queues):
             q = self._queues[key]
-            while len(q) >= self.max_batch:
+            while len(q) >= self.capacity:
                 self._dispatch(key)
                 n += 1
             if (q and self.max_wait_s is not None
@@ -178,15 +197,26 @@ class FleetService:
 
     # ---- dispatch ----------------------------------------------------
     def _width(self, k: int) -> int:
+        """Compiled lane width for a ``k``-request batch.
+
+        Every policy's width is rounded UP to a multiple of the mesh
+        size (a lane-sharded fleet needs ``B % n_devices == 0``;
+        without a mesh this is a no-op), and under a mesh the "full"
+        width is the whole-mesh :attr:`capacity` — one compiled width,
+        and so at most one build, per bucket either way.
+        """
         if self.pad_policy == "none":
-            return k
-        if self.pad_policy == "pow2":
-            return min(self.max_batch, 1 << (k - 1).bit_length())
-        return self.max_batch
+            w = k
+        elif self.pad_policy == "pow2":
+            w = min(self.capacity, 1 << (k - 1).bit_length())
+        else:
+            w = self.capacity
+        d = self.n_devices
+        return -(-w // d) * d
 
     def _dispatch(self, key: tuple) -> None:
         q = self._queues[key]
-        reqs = [q.popleft() for _ in range(min(len(q), self.max_batch))]
+        reqs = [q.popleft() for _ in range(min(len(q), self.capacity))]
         cfgs = [r.cfg for r in reqs]
         width = self._width(len(cfgs))
         padded = pad_configs(cfgs, width, self._filler[key])
@@ -210,6 +240,12 @@ class FleetService:
         wall = self.clock() - t0
         builds = run_build_count() - builds0
         occupancy = len(reqs) / width
+        # split the dispatch wall: device-wait (program execution,
+        # core/fleet.py times it around dispatch+block_until_ready) vs
+        # host stack/unstack — so a mesh speedup shows up where it
+        # lands (the device column) instead of vanishing into one
+        # number (stats()["mean_device_wait_s"]/["mean_host_s"])
+        device_wait = min(wall, float(fleet.device_seconds))
         now = self.clock()
         for req, lane in zip(reqs, fleet.lanes):
             self._handles.pop(req.rid)._complete(lane, RequestMetrics(
@@ -222,7 +258,9 @@ class FleetService:
         self._completed += len(reqs)
         self._dispatches.append({"bucket": key, "batch": len(reqs),
                                  "width": width, "occupancy": occupancy,
-                                 "wall_s": wall, "builds": builds})
+                                 "wall_s": wall, "builds": builds,
+                                 "device_wait_s": device_wait,
+                                 "host_s": max(0.0, wall - device_wait)})
         self._dispatch_count += 1
         bs = self._bucket_stats[key]
         bs["dispatches"] += 1
@@ -239,14 +277,18 @@ class FleetService:
         Under ``pad_policy="full"`` (the default: one width per
         bucket) a warmed bucket never builds on dispatch again; under
         ``"pow2"``/``"none"`` this warms the full-batch width only —
-        partial-batch widths still compile on first use.
+        partial-batch widths still compile on first use.  Warmth is
+        also bounded by the program cache: warming more than
+        ``cache_max_entries`` distinct buckets LRU-evicts the earliest
+        ones (programs included), so size the bound to the working set
+        before a warm sweep.
         """
         key = bucket_key(cfg, mode)
         sim = self.cache.get(key, cfg)
         self._filler.setdefault(key, cfg)
         self._bucket_stats.setdefault(key, {"requests": 0, "dispatches": 0,
                                             "builds": 0})
-        padded = pad_configs([cfg], self._width(self.max_batch), cfg)
+        padded = pad_configs([cfg], self._width(self.capacity), cfg)
         builds0 = run_build_count()
         if mode == "bench":
             sim.run_bench(configs=padded, warmup=False, n_real=1)
@@ -271,6 +313,9 @@ class FleetService:
         lat = np.asarray(self._latencies, dtype=np.float64)
         occ = np.asarray([d["occupancy"] for d in self._dispatches])
         hits = sum(1 for d in self._dispatches if d["builds"] == 0)
+        dev = np.asarray([d["device_wait_s"] for d in self._dispatches])
+        host = np.asarray([d["host_s"] for d in self._dispatches])
+        walls = dev + host
         out = {
             "requests": self._next_rid,
             "completed": self._completed,
@@ -283,9 +328,19 @@ class FleetService:
             if lat.size else 0.0,
             "program_hit_rate": round(hits / len(self._dispatches), 4)
             if self._dispatches else 0.0,
+            # where the per-dispatch wall goes: device-wait (the mesh
+            # lever moves this) vs host stack/unstack (it cannot)
+            "mean_device_wait_s": round(float(dev.mean()), 6)
+            if dev.size else 0.0,
+            "mean_host_s": round(float(host.mean()), 6)
+            if host.size else 0.0,
+            "device_wait_frac": round(float(dev.sum() / walls.sum()), 4)
+            if dev.size and walls.sum() > 0 else 0.0,
             "cache": self.cache.stats(),
             "max_batch": self.max_batch,
             "pad_policy": self.pad_policy,
+            "devices": self.n_devices,
+            "capacity": self.capacity,
         }
         out["buckets"] = {repr(k): dict(v)
                           for k, v in self._bucket_stats.items()}

@@ -6,6 +6,14 @@ Modes (positional args are [n] [ticks] [B]):
     python scripts/fleet_smoke.py time 2048 288 8    # fleet vs sequential A/B
     python scripts/fleet_smoke.py sweep 2048 288     # B in {1, 4, 8, 32}
     python scripts/fleet_smoke.py parity 64 64 4     # bit-parity, all paths
+    python scripts/fleet_smoke.py mesh 2048 288 8    # D in {1,2,4,8}, B lanes total
+
+``mesh`` sweeps the lane-mesh device count at FIXED total lane width
+(parallel/fleet_mesh.py): D=1 is the single-device vmapped fleet, each
+D>1 shards the same B lanes over D virtual CPU devices
+(XLA_FLAGS=--xla_force_host_platform_device_count=8 is forced before
+jax imports, mirroring tests/conftest.py) — the PERF §10 scaling
+curve.
 
 ``time`` runs the overlay-churn bench config both ways — B sequential
 ``OverlaySimulation`` runs, then the same B seeds as one
@@ -108,6 +116,41 @@ def _sweep(n, ticks):
               flush=True)
 
 
+def _mesh(n, ticks, lanes_total):
+    """Device-count sweep at fixed total lane width: the shard-parallel
+    leg of the PERF §10 decomposition (coverage elision and batching
+    are identical across rows — only D moves)."""
+    import jax
+
+    from gossip_protocol_tpu.core.fleet import FleetSimulation
+    from gossip_protocol_tpu.parallel.fleet_mesh import (
+        MeshFleetSimulation, make_lane_mesh)
+    cfg = _cfg(n, ticks)
+    print(f"backend={jax.default_backend()} devices={jax.device_count()} "
+          f"n={n} ticks={ticks} total_lanes={lanes_total}", flush=True)
+    if jax.device_count() < 2:
+        print("only 1 device live: mesh rows skipped (set XLA_FLAGS="
+              "--xla_force_host_platform_device_count=8)", flush=True)
+    seeds = list(range(21, 21 + lanes_total))
+    warm = list(range(121, 121 + lanes_total))
+    t1 = None
+    for d in (1, 2, 4, 8):
+        if d > jax.device_count() or lanes_total % d:
+            continue
+        fleet = FleetSimulation(cfg) if d == 1 \
+            else MeshFleetSimulation(cfg, make_lane_mesh(d))
+        fleet.run_bench(seeds=warm, warmup=False)      # compile + warm
+        t0 = time.perf_counter()
+        res = fleet.run_bench(seeds=seeds, warmup=False)
+        t = time.perf_counter() - t0
+        t1 = t if d == 1 else t1
+        agg = res.total_node_ticks / t
+        rel = f" ({t1 / t:5.2f}x the D=1 fleet)" if t1 and d > 1 else ""
+        print(f"  D={d} (B/dev={lanes_total // d}): {t:7.3f}s = "
+              f"{agg / 1e3:9.1f}k aggregate nt/s  "
+              f"dev {res.device_seconds:6.3f}s{rel}", flush=True)
+
+
 def _parity(n, ticks, batch):
     from gossip_protocol_tpu.config import SimConfig
     from gossip_protocol_tpu.core.fleet import (FleetSimulation,
@@ -183,7 +226,17 @@ def main():
     ticks = int(sys.argv[3]) if len(sys.argv) > 3 else 288
     batch = int(sys.argv[4]) if len(sys.argv) > 4 else 8
 
-    if mode == "parity":
+    if mode == "mesh":
+        # must land before jax is first imported (same rule as
+        # tests/conftest.py): the virtual-device flag is read at
+        # backend init
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+    if mode in ("parity", "mesh"):
         import jax
         jax.config.update("jax_platforms", "cpu")
 
@@ -193,6 +246,8 @@ def main():
         _sweep(n, ticks)
     elif mode == "parity":
         _parity(n, ticks, batch)
+    elif mode == "mesh":
+        _mesh(n, ticks, batch)
     else:
         raise SystemExit(f"unknown mode {mode!r}")
 

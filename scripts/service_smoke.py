@@ -8,6 +8,13 @@ Modes:
     python scripts/service_smoke.py replay 34 512 96  # seeds/tpl, overlay n, ticks
     python scripts/service_smoke.py quick             # small functional pass
     python scripts/service_smoke.py sweep             # max_batch sweep
+    python scripts/service_smoke.py mesh [34]         # replay per device count
+
+``mesh`` re-runs the acceptance replay served from a lane mesh
+(parallel/fleet_mesh.py) at each D in {1, 2, 4, 8} with EQUAL total
+lane width (max_batch = 8/D per device) — the PERF §10 serving curve;
+8 virtual CPU devices are forced before jax imports, mirroring
+tests/conftest.py.
 
 ``replay`` builds the acceptance stream — the three grader scenario
 kinds x two size tiers (the exact dense N=10 course scenarios, plus
@@ -26,7 +33,16 @@ is docs/PERF.md §9).
 """
 
 import json
+import os
 import sys
+
+if "mesh" in sys.argv[1:2]:
+    # virtual devices must be forced before jax is first imported
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
 
@@ -64,6 +80,29 @@ def main(argv) -> int:
             print(f"max_batch={b:2d}: {m['speedup_vs_sequential']:5.2f}x "
                   f"sequential, occupancy {m['mean_occupancy']:.2f}, "
                   f"p95 {m['latency_p95_s']:.2f}s", flush=True)
+        return 0
+    elif mode == "mesh":
+        from gossip_protocol_tpu.parallel.fleet_mesh import make_lane_mesh
+        seeds = int(argv[1]) if len(argv) > 1 else 34
+        seq = None          # sequential baseline shared across rows
+        for d in (1, 2, 4, 8):
+            if d > jax.device_count():
+                print(f"D={d}: skipped (only {jax.device_count()} "
+                      "devices live)", flush=True)
+                continue
+            mesh = None if d == 1 else make_lane_mesh(d)
+            if seq is None:
+                m, seq = replay(_templates(512, 96), seeds,
+                                max_batch=8 // d, mesh=mesh,
+                                return_legs=True)
+            else:
+                m = replay(_templates(512, 96), seeds, max_batch=8 // d,
+                           mesh=mesh, sequential=seq)
+            print(f"D={d}: {m['speedup_vs_sequential']:5.2f}x sequential, "
+                  f"occupancy {m['mean_occupancy']:.2f}, "
+                  f"p95 {m['latency_p95_s']:.2f}s, "
+                  f"device-wait frac {m['device_wait_frac']:.2f}",
+                  flush=True)
         return 0
     elif mode == "replay":
         seeds = int(argv[1]) if len(argv) > 1 else 34

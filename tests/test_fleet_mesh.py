@@ -1,0 +1,239 @@
+"""Mesh-parallel fleet: lane-axis sharding must be invisible in the
+results (parallel/fleet_mesh.py).
+
+The contract under test: a ``MeshFleetSimulation`` over D virtual CPU
+devices replays every lane bit-for-bit against the single-device
+fleet AND against solo runs — dense bench, dense trace, overlay XLA,
+and (interpret mode) the grid-kernel path — because lanes are
+embarrassingly parallel: the only shared carriers are the unbatched
+clock and, within a bucket, the drop plane, both REPLICATED across
+the mesh.  Plus the regressions that keep it fast and honest:
+
+* the replicated drop plane keeps the drop ``lax.cond`` a real cond
+  (a sharded/batched ``drop_active`` degrades it to a both-branches
+  select — pinned by jaxpr op-count, not wall clock);
+* a batch that does not divide the mesh is rejected with an
+  actionable error, and the serving layer pads to shard-divisible
+  widths (bit-parity through the padded mesh dispatch);
+* mesh programs carry their own cache identity (the device-count
+  cache-miss regression lives in tests/test_service.py).
+
+conftest forces 8 virtual CPU devices
+(``--xla_force_host_platform_device_count=8``); the guards below skip
+cleanly when fewer are live, mirroring tests/test_sharded.py.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from gossip_protocol_tpu.config import SimConfig
+from gossip_protocol_tpu.core.fleet import (FleetSimulation, _stack_scheds,
+                                            _stack_states, stack_lanes)
+from gossip_protocol_tpu.core.sim import Simulation
+from gossip_protocol_tpu.parallel.fleet_mesh import (MeshFleetSimulation,
+                                                     make_lane_mesh)
+from gossip_protocol_tpu.state import init_state, make_schedule
+
+
+def needs_devices(d):
+    return pytest.mark.skipif(
+        jax.device_count() < d, reason=f"needs {d} (virtual) devices")
+
+
+STATE_FIELDS = ("tick", "in_group", "own_hb", "known", "hb", "ts",
+                "gossip", "joinreq", "joinrep")
+OV_STATE_FIELDS = ("tick", "ids", "hb", "ts", "in_group", "own_hb",
+                   "send_flags", "joinreq", "joinrep")
+OV_METRIC_FIELDS = ("in_group", "view_slots", "adds", "removals",
+                    "false_removals", "victim_slots", "sent", "recv")
+
+SEEDS = [1, 2, 3, 4]
+
+
+def _dense_churn(n=32, ticks=60):
+    return SimConfig(max_nnb=n, single_failure=False, drop_msg=False,
+                     seed=0, total_ticks=ticks, fail_tick=20,
+                     rejoin_after=15)
+
+
+def _dense_drop(n=24, ticks=40):
+    return SimConfig(max_nnb=n, single_failure=True, drop_msg=True,
+                     msg_drop_prob=0.1, seed=0, total_ticks=ticks,
+                     fail_tick=15)
+
+
+def _overlay_churn(n=64, ticks=64):
+    return SimConfig(max_nnb=n, model="overlay", single_failure=False,
+                     drop_msg=False, seed=0, total_ticks=ticks,
+                     churn_rate=0.25, rejoin_after=16, step_rate=8.0 / n)
+
+
+def _assert_state_equal(ref_state, lane_state, fields, ctx):
+    for f in fields:
+        a = np.asarray(getattr(ref_state, f))
+        b = np.asarray(getattr(lane_state, f))
+        assert np.array_equal(a, b), f"{ctx}: state field {f} diverged"
+
+
+# ---- per-lane bit-parity across device counts ------------------------
+@needs_devices(2)
+@pytest.mark.parametrize("d", [2, 4])
+def test_mesh_dense_bench_parity(d):
+    """D-device mesh bench fleet == solo run_bench, per lane."""
+    if jax.device_count() < d:
+        pytest.skip(f"needs {d} devices")
+    cfg = _dense_drop()
+    mesh = MeshFleetSimulation(cfg, make_lane_mesh(d)).run_bench(seeds=SEEDS)
+    sim = Simulation(cfg)
+    assert mesh.batch == len(SEEDS)
+    assert 0.0 < mesh.device_seconds <= mesh.wall_seconds
+    for i, s in enumerate(SEEDS):
+        ref = sim.run_bench(seed=s)
+        lane = mesh.lanes[i]
+        _assert_state_equal(ref.final_state, lane.final_state,
+                            STATE_FIELDS, f"D={d} lane {i}")
+        assert np.array_equal(ref.sent, lane.sent), i
+        assert np.array_equal(ref.recv, lane.recv), i
+
+
+@needs_devices(2)
+def test_mesh_dense_trace_parity():
+    """Trace-mode mesh fleet: events (and so grades) match solo runs,
+    whole and tick-chunked (chunking is a staging detail)."""
+    cfg = _dense_drop()
+    d = 2
+    whole = MeshFleetSimulation(cfg, make_lane_mesh(d)).run(seeds=SEEDS)
+    parts = MeshFleetSimulation(cfg, make_lane_mesh(d),
+                                chunk_ticks=16).run(seeds=SEEDS)
+    sim = Simulation(cfg)
+    for i, s in enumerate(SEEDS):
+        ref = sim.run(seed=s)
+        for tag, lane in (("whole", whole.lanes[i]), ("chunk", parts.lanes[i])):
+            assert np.array_equal(ref.added, lane.added), (tag, i)
+            assert np.array_equal(ref.removed, lane.removed), (tag, i)
+            assert np.array_equal(ref.sent, lane.sent), (tag, i)
+            assert np.array_equal(ref.recv, lane.recv), (tag, i)
+            _assert_state_equal(ref.final_state, lane.final_state,
+                                STATE_FIELDS, f"{tag} lane {i}")
+
+
+@needs_devices(2)
+@pytest.mark.parametrize("d", [2, 4, 8])
+def test_mesh_overlay_parity(d):
+    """Overlay mesh fleet across device counts: states and metrics
+    bit-equal to solo runs and to the single-device fleet (which
+    tests/test_fleet.py pins against solo already)."""
+    if jax.device_count() < d:
+        pytest.skip(f"needs {d} devices")
+    from gossip_protocol_tpu.models.overlay import OverlaySimulation
+    cfg = _overlay_churn()
+    seeds = list(range(1, 9))            # B=8 divides every tested D
+    fleet = MeshFleetSimulation(cfg, make_lane_mesh(d)).run(seeds=seeds)
+    for i, s in enumerate(seeds):
+        ref = OverlaySimulation(cfg.replace(seed=s), use_pallas=False).run()
+        lane = fleet.lanes[i]
+        _assert_state_equal(ref.final_state, lane.final_state,
+                            OV_STATE_FIELDS, f"D={d} lane {i}")
+        for m in OV_METRIC_FIELDS:
+            a = np.asarray(getattr(ref.metrics, m))
+            b = np.asarray(getattr(lane.metrics, m))
+            assert np.array_equal(a, b), f"D={d} lane {i}: metric {m}"
+        # the fleet tick elides the coverage histogram, like mega/grid
+        assert np.all(np.asarray(lane.metrics.live_uncovered) == -1)
+
+
+@needs_devices(2)
+def test_mesh_matches_grid_fleet_interpret():
+    """The mesh fleet replays the batched grid kernel (interpret mode
+    on CPU — the same kernel compiles on TPU) bit-for-bit per lane:
+    the lane mesh and the leading-batch-grid-dimension kernel are two
+    executions of one trajectory."""
+    from gossip_protocol_tpu.models.overlay import (init_overlay_state,
+                                                    make_overlay_schedule)
+    from gossip_protocol_tpu.models.overlay_grid import make_grid_fleet_run
+    cfg = _overlay_churn(ticks=32)       # two GRID_TICKS launches
+    cfgs = [cfg.replace(seed=s) for s in (5, 6)]
+    mesh = MeshFleetSimulation(cfg, make_lane_mesh(2)).run(
+        configs=cfgs)
+    scheds = [make_overlay_schedule(c) for c in cfgs]
+    states = _stack_states([init_overlay_state(c) for c in cfgs])
+    grid = make_grid_fleet_run(cfg, cfg.total_ticks, 2, block_rows=32,
+                               start_tick=0)
+    gf, gm = grid(states, stack_lanes(scheds))
+    for i in range(2):
+        lane = mesh.lanes[i]
+        for f in OV_STATE_FIELDS:
+            a = np.asarray(getattr(lane.final_state, f))
+            b = np.asarray(getattr(gf, f)) if f == "tick" \
+                else np.asarray(getattr(gf, f))[i]
+            assert np.array_equal(a, b), f"lane {i}: state {f}"
+        for m in OV_METRIC_FIELDS:
+            a = np.asarray(getattr(lane.metrics, m))
+            b = np.asarray(getattr(gm, m))[i]
+            assert np.array_equal(a, b), f"lane {i}: metric {m}"
+
+
+# ---- replicated drop plane (regression) ------------------------------
+@needs_devices(2)
+def test_mesh_shared_drop_plane_keeps_cond():
+    """The SCHED_AXES_SHARED_DROP rule must survive sharding: with the
+    drop plane replicated, the drop draw stays a real ``lax.cond`` in
+    the mesh program's jaxpr; batching the plane per lane erases the
+    cond (both branches inlined under a select) — the 2.6x regression
+    PERF §9 measured.  Pinned by op-count, not wall clock."""
+    cfg = _dense_drop(n=16, ticks=30)
+    sim = MeshFleetSimulation(cfg, make_lane_mesh(2))
+    cfgs = [cfg.replace(seed=s) for s in (1, 2)]
+    scheds = [make_schedule(c) for c in cfgs]
+    states = _stack_states([init_state(c) for c in cfgs])
+
+    shared = sim._dense_bench_fn(2, cfg.n, True)
+    jx_shared = str(jax.make_jaxpr(shared.jitted)(
+        states, _stack_scheds(scheds, True)))
+    states = _stack_states([init_state(c) for c in cfgs])
+    batched = sim._dense_bench_fn(2, cfg.n, False)
+    jx_batched = str(jax.make_jaxpr(batched.jitted)(
+        states, _stack_scheds(scheds, False)))
+    assert jx_shared.count("cond[") > jx_batched.count("cond["), (
+        "replicated drop plane no longer lowers to a real cond — the "
+        "drop draw is running every tick as a both-branches select")
+
+
+# ---- batch/mesh geometry ---------------------------------------------
+@needs_devices(2)
+def test_mesh_rejects_indivisible_batch():
+    cfg = _overlay_churn()
+    sim = MeshFleetSimulation(cfg, make_lane_mesh(2))
+    with pytest.raises(ValueError, match="divide.*mesh"):
+        sim.run(seeds=[1, 2, 3])
+    with pytest.raises(ValueError, match="devices are available"):
+        make_lane_mesh(jax.device_count() + 1)
+    with pytest.raises(ValueError, match="1-D lane mesh"):
+        from jax.sharding import Mesh
+        MeshFleetSimulation(cfg, Mesh(
+            np.array(jax.devices()[:2]).reshape(2, 1), ("a", "b")))
+
+
+@needs_devices(2)
+def test_mesh_service_shard_divisible_padding_parity():
+    """A partial batch through a mesh service pads to a
+    shard-divisible width and every real lane stays bit-identical to
+    its solo run — the serving layer's mesh contract."""
+    from gossip_protocol_tpu.service import FleetService
+    cfg = _dense_churn(n=16, ticks=22)
+    svc = FleetService(max_batch=2, mesh=make_lane_mesh(2))
+    assert svc.capacity == 4
+    handles = [svc.submit(cfg, seed=s) for s in (1, 2, 3)]
+    svc.drain()
+    sim = Simulation(cfg)
+    for s, h in zip((1, 2, 3), handles):
+        ref = sim.run(seed=s)
+        lane = h.result()
+        assert np.array_equal(ref.added, lane.added), s
+        assert np.array_equal(ref.sent, lane.sent), s
+        _assert_state_equal(ref.final_state, lane.final_state,
+                            STATE_FIELDS, f"seed {s}")
+        m = h.metrics
+        assert m.batch == 3 and m.padded_batch == 4
+        assert m.padded_batch % 2 == 0

@@ -297,6 +297,96 @@ def test_smoke_replay_fast():
     assert m["mean_occupancy"] > 0.5
 
 
+# ---- mesh-aware program cache (satellite) ----------------------------
+@pytest.mark.skipif(__import__("jax").device_count() < 2,
+                    reason="needs 2 (virtual) devices")
+def test_mesh_device_count_misses_program_cache():
+    """A device-count change can never be served a stale program: the
+    same bucket served by services over different lane meshes (and
+    over none) compiles fresh each time — both the service-level
+    ProgramCache and the process-wide fleet-program cache key on the
+    mesh descriptor — while results stay bit-identical."""
+    from gossip_protocol_tpu.parallel.fleet_mesh import make_lane_mesh
+    cfg = _dense_churn(n=16, ticks=24)
+    ref = Simulation(cfg).run(seed=1)
+
+    svc1 = FleetService(max_batch=2)                       # no mesh
+    h1 = [svc1.submit(cfg, seed=s) for s in (1, 2)]
+    svc1.drain()
+    built = run_build_count()
+    svc2 = FleetService(max_batch=1, mesh=make_lane_mesh(2))
+    h2 = [svc2.submit(cfg, seed=s) for s in (1, 2)]
+    svc2.drain()
+    assert run_build_count() > built, \
+        "the 2-device mesh dispatch reused the single-device program"
+    assert svc2.cache.stats()["misses"] >= 1
+    built = run_build_count()
+    if __import__("jax").device_count() >= 4:
+        svc4 = FleetService(max_batch=1, mesh=make_lane_mesh(4))
+        h4 = [svc4.submit(cfg, seed=s) for s in (1, 2, 3, 4)]
+        svc4.drain()
+        assert run_build_count() > built, \
+            "the 4-device mesh dispatch reused the 2-device program"
+        assert np.array_equal(h4[0].result().sent, ref.sent)
+    # same bucket, same results, regardless of mesh
+    assert np.array_equal(h1[0].result().sent, ref.sent)
+    assert np.array_equal(h2[0].result().sent, ref.sent)
+
+
+def test_program_cache_lru_eviction_counts():
+    """Satellite: the ProgramCache is bounded — inserting past
+    max_entries evicts LRU (and its compiled programs) and counts it
+    in stats()."""
+    from gossip_protocol_tpu.service.cache import ProgramCache
+    shapes = [_dense_churn(n=12, ticks=20 + i) for i in range(3)]
+    pc = ProgramCache(max_entries=2)
+    sims = [pc.get(bucket_key(c, "trace"), c) for c in shapes]
+    st = pc.stats()
+    assert st["buckets"] == 2 and st["evictions"] == 1, st
+    # the survivor handles are still served as hits
+    assert pc.get(bucket_key(shapes[2], "trace"), shapes[2]) is sims[2]
+    assert pc.stats()["hits"] == 1
+    # re-asking for the evicted shape is a miss (rebuilt handle)
+    assert pc.get(bucket_key(shapes[0], "trace"), shapes[0]) is not sims[0]
+    with pytest.raises(ValueError, match="max_entries"):
+        ProgramCache(max_entries=0)
+
+
+def test_lru_eviction_spares_sibling_bucket_programs():
+    """Eviction is exact: dropping one bucket removes only the
+    programs THAT bucket's handle touched — a sibling bucket sharing
+    the config shape (other mode) keeps its compiled programs."""
+    from gossip_protocol_tpu.service.cache import ProgramCache
+    cfg = _dense_churn(n=12, ticks=18)
+    pc = ProgramCache(max_entries=1)
+    trace_sim = pc.get(bucket_key(cfg, "trace"), cfg)
+    trace_sim.run(seeds=[1])                     # trace program built
+    FleetSimulation(cfg).run_bench(seeds=[1])    # sibling bench program
+    built = run_build_count()
+    # inserting the bench bucket evicts the trace bucket + its programs
+    pc.get(bucket_key(cfg, "bench"), cfg)
+    assert pc.stats()["evictions"] == 1
+    FleetSimulation(cfg).run_bench(seeds=[2])    # bench program survived
+    assert run_build_count() == built, \
+        "evicting the trace bucket also evicted the bench program"
+    FleetSimulation(cfg).run(seeds=[2])          # trace program is gone
+    assert run_build_count() == built + 1
+
+
+def test_stats_device_host_split():
+    """Satellite: stats() splits the per-dispatch wall into
+    device-wait vs host stack/unstack time."""
+    cfg = _dense_churn(n=16, ticks=22)
+    svc = FleetService(max_batch=2)
+    [svc.submit(cfg, seed=s) for s in (1, 2)]
+    svc.drain()
+    st = svc.stats()
+    assert st["mean_device_wait_s"] > 0.0
+    assert st["mean_host_s"] >= 0.0
+    assert 0.0 < st["device_wait_frac"] <= 1.0
+    assert st["devices"] == 1 and st["capacity"] == 2
+
+
 @pytest.mark.slow
 def test_full_replay_acceptance():
     """The acceptance criterion, as a test: >= 200 mixed requests,
