@@ -533,7 +533,57 @@ def main():
                                      max_batch=sv_lanes, return_legs=True)
         secondary["service_replay_mixed"] = _sv_entry(sv)
 
+        # chaos-hardened serving (PR 5, docs/SERVING.md "Failure
+        # model"): the same stream under a SEEDED fault schedule
+        # (~12% dispatch-boundary faults + one mid-replay device
+        # loss).  chaos_replay raises unless 100% of requests complete
+        # with per-request parity, so this entry existing IS the gate;
+        # the seed + digests + env make the run replayable evidence.
+        # When >1 (virtual) device is live the stream is served from a
+        # 2-device lane mesh, so the device loss exercises the real
+        # degradation ladder (mesh -> single device) instead of being
+        # a mere retried transient.
+        from gossip_protocol_tpu.service import chaos_replay
         import jax
+        import os as _os
+        chaos_d = 2 if (jax.device_count() > 1 and sv_lanes % 2 == 0) \
+            else 1
+        chaos_mesh = None
+        if chaos_d > 1:
+            from gossip_protocol_tpu.parallel.fleet_mesh import \
+                make_lane_mesh as _mk_mesh
+            chaos_mesh = _mk_mesh(chaos_d)
+        ch = chaos_replay(sv_templates, seeds_per_template=seeds_sv,
+                          max_batch=sv_lanes // chaos_d,
+                          mesh=chaos_mesh, fault_seed=20260804,
+                          fault_rate=0.12, sequential=seq_leg)
+        secondary["service_replay_chaos"] = {
+            "fault_seed": ch["fault_seed"],
+            "fault_rate": ch["fault_rate"],
+            "device_loss_at": ch["device_loss_at"],
+            "requests": ch["requests"],
+            "completion_rate": ch["completion_rate"],
+            "stranded": ch["stranded"],
+            "degraded_requests": ch["degraded_requests"],
+            "faults": ch["faults"],
+            "retries": ch["failures"]["retries"],
+            "backoff_s": ch["failures"]["backoff_s"],
+            "device_losses": ch["failures"]["device_losses"],
+            "mesh_rebuilds": ch["failures"]["mesh_rebuilds"],
+            "devices_start": ch["devices_start"],
+            "devices_end": ch["devices_end"],
+            "latency_p50_s": ch["latency_p50_s"],
+            "latency_p95_s": ch["latency_p95_s"],
+            "speedup_vs_sequential": ch["speedup_vs_sequential"],
+            "schedule_digest": ch["schedule_digest"],
+            "outcome_digest": ch["outcome_digest"],
+            "parity_checked": ch["parity_checked"],
+            "env": {
+                "device_count": jax.device_count(),
+                "jax_backend": jax.default_backend(),
+                "xla_flags": _os.environ.get("XLA_FLAGS", ""),
+            },
+        }
         if jax.device_count() > 1:
             # lane-mesh serving (parallel/fleet_mesh.py) at EQUAL total
             # lane width: max_batch is per-device and d must DIVIDE

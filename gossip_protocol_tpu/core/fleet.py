@@ -208,6 +208,19 @@ def _fleet_fn(key, builder):
     return _FLEET_FN_CACHE[key]
 
 
+def _check_unstacked(lanes, n_real: int) -> None:
+    """Filler-lane invariant, enforced at the unstack boundary: a
+    fleet hands back EXACTLY its real lanes — one per request, filler
+    never among them.  The serving layer zips lanes against requests,
+    so a miscount here would silently mispair results (or strand
+    handles); failing loudly turns it into an ordinary retryable
+    dispatch error (service/resilience.py)."""
+    if len(lanes) != n_real:
+        raise RuntimeError(
+            f"fleet unstacked {len(lanes)} lanes but n_real={n_real}; "
+            "filler lanes must never be unstacked into results")
+
+
 @dataclass
 class FleetResult:
     """A finished fleet: per-lane results plus the one shared wall.
@@ -493,6 +506,7 @@ class FleetSimulation:
                 wall_seconds=wall,
                 counter_stream_width=bench_stream_width(c),
             ))
+        _check_unstacked(lanes, nr)
         return FleetResult(lanes=lanes, wall_seconds=wall,
                            padded_batch=len(cfgs) if nr < len(cfgs) else 0,
                            device_seconds=t_dev)
@@ -595,6 +609,7 @@ class FleetSimulation:
                 final_state=_lane_state(states, i),
                 wall_seconds=wall,
             ))
+        _check_unstacked(lanes, nr)
         return FleetResult(lanes=lanes, wall_seconds=wall,
                            padded_batch=b if nr < b else 0,
                            device_seconds=t_dev)
@@ -642,6 +657,7 @@ class FleetSimulation:
             metrics=jax.tree.map(lambda m, _i=i: m[_i], metrics_h),
             wall_seconds=wall,
         ) for i, c in enumerate(cfgs[:nr])]
+        _check_unstacked(lanes, nr)
         return FleetResult(lanes=lanes, wall_seconds=wall,
                            padded_batch=b if nr < b else 0,
                            device_seconds=t_dev)

@@ -95,6 +95,27 @@ def mesh_descriptor(mesh: Mesh) -> tuple:
     return (mesh.axis_names, tuple(d.id for d in mesh.devices.flat))
 
 
+def shrink_mesh(mesh: Optional[Mesh]) -> Optional[Mesh]:
+    """One rung down the serving degradation ladder: the same lane
+    mesh minus its LAST device (``None`` once fewer than two remain —
+    the single-device fleet needs no mesh at all).
+
+    This is the rebuild path the service takes on a (simulated or
+    real) device loss: the shrunken mesh has a fresh
+    :func:`mesh_descriptor`, so every program cache that keys on the
+    mesh (``_FLEET_FN_CACHE``, the service ``ProgramCache``) misses by
+    construction and the bucket recompiles for the smaller device set
+    — a stale wide program can never be dispatched onto the survivors
+    (service/scheduler.py ``_degrade_mesh``).
+    """
+    if mesh is None:
+        return None
+    devs = list(mesh.devices.flat)[:-1]
+    if len(devs) < 2:
+        return None
+    return Mesh(np.array(devs), mesh.axis_names)
+
+
 def _axes_to_specs(axes):
     """vmap axes tree -> PartitionSpec tree: batched leaves are
     lane-sharded, unbatched leaves (the clock, the shared drop plane)

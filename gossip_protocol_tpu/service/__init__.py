@@ -14,8 +14,16 @@ mesh-keyed program caches.  See docs/SERVING.md.
 
 from .bucket import bucket_key, pad_configs
 from .cache import ProgramCache
-from .replay import (Template, build_trace, grader_templates,
-                     overlay_templates, replay)
+from .faults import (FAULT_KINDS, FaultInjector, InjectedCompileFailure,
+                     InjectedDeviceLoss, InjectedDispatchFailure,
+                     InjectedFault)
+from .replay import (Template, build_trace, chaos_replay,
+                     grader_templates, overlay_templates, replay)
+from .resilience import (BreakerPolicy, BucketQuarantined, CircuitBreaker,
+                         DeadlineExceeded, DispatchFailed,
+                         PoisonedLaneError, RetryPolicy, ServiceError,
+                         ShedRejection, solo_execute, solo_run,
+                         validate_lane)
 from .scheduler import PAD_POLICIES, FleetService
 from .types import MODES, RequestHandle, RequestMetrics, SimRequest
 
@@ -23,5 +31,12 @@ __all__ = [
     "FleetService", "ProgramCache", "RequestHandle", "RequestMetrics",
     "SimRequest", "Template", "bucket_key", "build_trace",
     "grader_templates", "overlay_templates", "pad_configs", "replay",
-    "MODES", "PAD_POLICIES",
+    "chaos_replay", "MODES", "PAD_POLICIES",
+    # the failure model (PR 5): the fault plane + resilience machinery
+    "FAULT_KINDS", "FaultInjector", "InjectedFault",
+    "InjectedCompileFailure", "InjectedDispatchFailure",
+    "InjectedDeviceLoss", "RetryPolicy", "BreakerPolicy",
+    "CircuitBreaker", "ServiceError", "ShedRejection",
+    "DeadlineExceeded", "DispatchFailed", "PoisonedLaneError",
+    "BucketQuarantined", "solo_execute", "solo_run", "validate_lane",
 ]

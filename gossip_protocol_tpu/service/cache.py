@@ -58,6 +58,7 @@ class ProgramCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.mesh_rebinds = 0
         self._builds0 = run_build_count()
 
     def _make_sim(self, cfg: SimConfig) -> FleetSimulation:
@@ -76,11 +77,12 @@ class ProgramCache:
         any same-bucket config return the same handle.  Entries are
         touched LRU-wise; inserting past ``max_entries`` evicts the
         least recently used bucket AND its compiled programs.  The
-        cache's mesh is fixed at construction (one service, one mesh),
-        so the bucket key alone identifies an entry here; cross-mesh
-        staleness is impossible anyway because the handles' compiled
-        programs carry the mesh slot in their own process-cache keys
-        (core/fleet.py ``_mesh_entry``).
+        cache serves ONE mesh at a time (set at construction;
+        :meth:`rebind_mesh` moves it down the degradation ladder and
+        drops every handle), so the bucket key alone identifies an
+        entry here; cross-mesh staleness is impossible anyway because
+        the handles' compiled programs carry the mesh slot in their
+        own process-cache keys (core/fleet.py ``_mesh_entry``).
         """
         sim = self._sims.get(key)
         if sim is None:
@@ -96,6 +98,25 @@ class ProgramCache:
             self.hits += 1
             self._sims.move_to_end(key)
         return sim
+
+    def rebind_mesh(self, mesh) -> int:
+        """Graceful mesh degradation (PR 5): re-point the cache at a
+        smaller mesh (or ``None`` for single-device) after a device
+        loss.  Every bucket handle is dropped — their compiled
+        programs target a mesh that no longer exists — and each
+        handle's programs are evicted from the process caches
+        per-handle-exactly (``FleetSimulation.evict_programs``), so
+        sibling buckets owned by OTHER drivers keep theirs.  The next
+        ``get`` per bucket rebuilds on the new mesh through the same
+        mesh-keyed cache keys that already made cross-mesh staleness
+        impossible.  Returns how many bucket handles were dropped."""
+        n = len(self._sims)
+        for sim in self._sims.values():
+            sim.evict_programs()
+        self._sims.clear()
+        self._mesh = mesh
+        self.mesh_rebinds += 1
+        return n
 
     @property
     def builds(self) -> int:
@@ -119,6 +140,7 @@ class ProgramCache:
                 "hit_rate": round(self.hit_rate, 4),
                 "builds": self.builds,
                 "evictions": self.evictions,
+                "mesh_rebinds": self.mesh_rebinds,
                 "max_entries": self.max_entries,
                 "devices": (self._mesh.devices.size
                             if self._mesh is not None else 1)}

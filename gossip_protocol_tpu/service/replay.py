@@ -103,14 +103,12 @@ def build_trace(templates: list[Template],
 
 
 def _solo_run(tpl: Template, seed: int):
-    """Direct single-simulation execution of one request."""
-    cfg = tpl.cfg.replace(seed=seed)
-    if cfg.model == "overlay":
-        from ..models.overlay import OverlaySimulation
-        return OverlaySimulation(cfg, use_pallas=False).run()
-    from ..core.sim import Simulation
-    sim = Simulation(cfg)
-    return sim.run_bench() if tpl.mode == "bench" else sim.run()
+    """Direct single-simulation execution of one request — the SAME
+    implementation the degradation fallback uses
+    (service/resilience.py ``solo_execute``), so the parity reference
+    and the fallback cannot drift apart."""
+    from .resilience import solo_execute
+    return solo_execute(tpl.cfg.replace(seed=seed), tpl.mode)
 
 
 def run_sequential(trace) -> tuple[list, float]:
@@ -227,6 +225,18 @@ def replay(templates: list[Template], seeds_per_template: int,
                 f"the trace has {len(trace)} requests; both replays "
                 "must use the same templates and seeds_per_template")
     svc_results, svc, svc_wall = run_service(trace, service=svc)
+    # the clean-path harness must stay loud about engine failures: the
+    # resilient scheduler would otherwise convert a broken fleet path
+    # into solo-run fallbacks that pass parity trivially (solo IS the
+    # reference) — a fault-free replay that degrades anything is a bug
+    fail_stats = svc.stats()
+    if fail_stats["failed"] or fail_stats["failures"]["degraded_requests"]:
+        raise RuntimeError(
+            f"fault-free replay had {fail_stats['failed']} failed and "
+            f"{fail_stats['failures']['degraded_requests']} degraded "
+            f"requests (retries="
+            f"{fail_stats['failures']['retries']}); the fleet dispatch "
+            "path is broken — its errors are on the request handles")
     if check_parity:
         bad = verify_parity(trace, seq_results, svc_results)
         if bad:
@@ -264,6 +274,135 @@ def replay(templates: list[Template], seeds_per_template: int,
         "max_builds_per_bucket": max(per_bucket_builds, default=0),
         "dispatches": stats["dispatches"],
         "parity_checked": bool(check_parity),
+    }
+    if return_legs:
+        return metrics, (seq_results, seq_wall)
+    return metrics
+
+
+def chaos_replay(templates: list[Template], seeds_per_template: int,
+                 max_batch: int = 8, mesh=None, fault_seed: int = 0,
+                 fault_rate: float = 0.12, device_loss_at="mid",
+                 max_retries: int = 4, backoff_base_s: float = 0.01,
+                 sequential=None, return_legs: bool = False):
+    """The chaos acceptance harness: the mixed replay under a SEEDED
+    fault schedule (service/faults.py) plus one mid-replay device
+    loss, with the gate enforced in-line:
+
+    * **100% completion, 0 stranded handles** — every submitted
+      request reaches a terminal state, and every terminal state is a
+      result (completed or degraded-to-solo); any failed or pending
+      handle raises.
+    * **bit-parity for every non-degraded request** against the
+      sequential solo leg (degraded requests ARE solo runs, so they
+      are checked too — a degraded mismatch raises just the same).
+    * **replayability** — the returned ``fault_events`` /
+      ``schedule_digest`` / ``outcomes`` are pure functions of
+      ``(templates, seeds_per_template, max_batch, mesh, fault_seed,
+      fault_rate, device_loss_at)``: two runs with the same arguments
+      produce identical fault sequences and identical per-request
+      outcomes.  Nothing may depend on wall time: ``max_wait_s`` stays
+      None (dispatch order is a pure function of submit order) and the
+      circuit-breaker cooldown is infinite (an opened bucket stays
+      deterministically quarantined rather than half-open-probing on
+      elapsed wall time).
+
+    ``device_loss_at="mid"`` schedules the loss at roughly the middle
+    dispatch; pass an attempt index to pin it, or None for no loss.
+    ``sequential=``/``return_legs=`` share one solo baseline across
+    several chaos configurations, exactly like :func:`replay`.
+    """
+    from .faults import FaultInjector
+    from .resilience import BreakerPolicy, RetryPolicy
+    trace = build_trace(templates, seeds_per_template)
+    n_dev = int(mesh.devices.size) if mesh is not None else 1
+    if device_loss_at == "mid":
+        # roughly the middle fault-free dispatch of the stream
+        dispatches = max(1, len(trace) // max(1, max_batch * n_dev))
+        device_loss_at = max(2, dispatches // 2)
+    injector = FaultInjector(seed=fault_seed, fault_rate=fault_rate,
+                             device_loss_at=device_loss_at)
+    svc = FleetService(
+        max_batch=max_batch, mesh=mesh, injector=injector,
+        retry=RetryPolicy(max_retries=max_retries,
+                          backoff_base_s=backoff_base_s,
+                          seed=fault_seed),
+        # determinism requires every scheduling decision to be a pure
+        # function of the seeded arguments: max_wait_s stays None (no
+        # time-based flushes) and the breaker cooldown is infinite —
+        # a bucket the fault schedule manages to open stays
+        # deterministically quarantined (its requests degrade to solo,
+        # which still completes and parity-checks) instead of
+        # half-open-probing on real elapsed wall time
+        breaker=BreakerPolicy(reset_after_s=float("inf")))
+    warm(trace, svc)
+    if sequential is None:
+        seq_results, seq_wall = run_sequential(trace)
+    else:
+        seq_results, seq_wall = sequential
+        if len(seq_results) != len(trace):
+            raise ValueError(
+                f"sequential= leg has {len(seq_results)} results but "
+                f"the trace has {len(trace)} requests")
+    t0 = time.perf_counter()
+    handles = [svc.submit(tpl.cfg, seed=seed, mode=tpl.mode)
+               for tpl, seed in trace]
+    svc.drain()
+    svc_wall = time.perf_counter() - t0
+
+    stranded = [h.request.rid for h in handles if not h.done]
+    failed = [h.request.rid for h in handles if h.failed]
+    if stranded or failed:
+        errs = "; ".join(
+            f"rid {h.request.rid}: {h.exception()!r}"
+            for h in handles if h.failed)[:500]
+        raise RuntimeError(
+            f"chaos replay left {len(stranded)} stranded and "
+            f"{len(failed)} failed handles of {len(handles)} "
+            f"(seed={fault_seed}): {errs}")
+    svc_results = [h.result() for h in handles]
+    degraded = [h.request.rid for h in handles
+                if h.status == "degraded"]
+    bad = verify_parity(trace, seq_results, svc_results)
+    # degraded requests are served by the parity reference itself
+    # (solo runs), so ANY mismatch — degraded or not — is a failure
+    if bad:
+        raise RuntimeError(
+            f"chaos replay diverged from solo runs ({len(bad)}): "
+            + "; ".join(bad[:5]))
+    stats = svc.stats()
+    outcomes = [(h.request.rid, h.status, h.metrics.retries)
+                for h in handles]
+    import hashlib
+    outcome_digest = hashlib.sha256(
+        repr(outcomes).encode()).hexdigest()[:16]
+    metrics = {
+        "requests": len(trace),
+        "completed": len(svc_results),
+        "stranded": 0,
+        "failed": 0,
+        "completion_rate": 1.0,
+        "degraded_requests": len(degraded),
+        "parity_checked": True,
+        "fault_seed": fault_seed,
+        "fault_rate": fault_rate,
+        "device_loss_at": device_loss_at,
+        "faults": injector.summary(),
+        "fault_events": list(injector.events),
+        "schedule_digest": injector.schedule_digest(),
+        "outcome_digest": outcome_digest,
+        "outcomes": outcomes,
+        "failures": stats["failures"],
+        "devices_start": n_dev,
+        "devices_end": stats["devices"],
+        "sequential_wall_s": round(seq_wall, 3),
+        "service_wall_s": round(svc_wall, 3),
+        "speedup_vs_sequential": round(seq_wall / svc_wall, 2),
+        "latency_p50_s": stats["latency_p50_s"],
+        "latency_p95_s": stats["latency_p95_s"],
+        "mean_occupancy": stats["mean_occupancy"],
+        "dispatches": stats["dispatches"],
+        "breaker_open_buckets": stats["breaker_open_buckets"],
     }
     if return_legs:
         return metrics, (seq_results, seq_wall)

@@ -36,6 +36,19 @@ driver) and time-based flushes happen cooperatively inside
 ``submit``/``pump`` — there is no background thread to race the JAX
 runtime.  ``drain()`` (or exiting the context manager) flushes
 everything outstanding.
+
+Failure model (PR 5, docs/SERVING.md "Failure model"): dispatching is
+ATOMIC — every request popped for a dispatch reaches a terminal state
+(completed, degraded to a solo run, or failed with a typed error on
+its handle) before the dispatch returns; nothing is ever re-queued
+into limbo.  The machinery is service/resilience.py (bounded retry
+with seeded exponential backoff, per-request deadlines, a per-bucket
+circuit breaker that quarantines repeat offenders onto the solo
+fallback, queue-depth admission control with typed shedding) plus
+graceful mesh degradation: a device loss shrinks the lane mesh
+(parallel/fleet_mesh.py ``shrink_mesh``) and rebuilds the bucket's
+programs through the mesh-keyed caches.  All of it is exercised
+deterministically by the seeded fault plane in service/faults.py.
 """
 
 from __future__ import annotations
@@ -50,6 +63,12 @@ from ..config import SimConfig
 from ..core.tick import run_build_count
 from .bucket import bucket_key, pad_configs
 from .cache import ProgramCache
+from .faults import FaultInjector, InjectedCompileFailure, \
+    InjectedDeviceLoss, InjectedDispatchFailure
+from .resilience import (BreakerPolicy, BucketQuarantined, CircuitBreaker,
+                         DeadlineExceeded, DispatchFailed,
+                         PoisonedLaneError, RetryPolicy, ShedRejection,
+                         solo_run, validate_lane)
 from .types import MODES, RequestHandle, RequestMetrics, SimRequest
 
 #: padding policies: "full" pads every dispatch to ``max_batch`` (one
@@ -87,12 +106,21 @@ class FleetService:
                  pad_policy: str = "full", block_size: int = 128,
                  chunk_ticks: Optional[int] = None, clock=time.perf_counter,
                  stats_window: int = 1 << 14, mesh=None,
-                 cache_max_entries: Optional[int] = 64):
+                 cache_max_entries: Optional[int] = 64,
+                 injector: Optional[FaultInjector] = None,
+                 retry: Optional[RetryPolicy] = None,
+                 breaker: Optional[BreakerPolicy] = None,
+                 max_queue_depth: Optional[int] = None,
+                 default_deadline_s: Optional[float] = None,
+                 degrade_to_solo: bool = True, sleep=time.sleep):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if pad_policy not in PAD_POLICIES:
             raise ValueError(f"unknown pad_policy {pad_policy!r}; "
                              f"expected one of {PAD_POLICIES}")
+        if max_queue_depth is not None and max_queue_depth < 1:
+            raise ValueError(f"max_queue_depth must be >= 1 or None, "
+                             f"got {max_queue_depth}")
         self.max_batch = max_batch
         self.max_wait_s = max_wait_s
         self.pad_policy = pad_policy
@@ -102,11 +130,25 @@ class FleetService:
         self.cache = ProgramCache(block_size=block_size,
                                   chunk_ticks=chunk_ticks, mesh=mesh,
                                   max_entries=cache_max_entries)
+        # failure plane: the (optional) deterministic fault injector
+        # and the machinery that survives it (service/resilience.py)
+        self.injector = injector
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.breaker = CircuitBreaker(breaker if breaker is not None
+                                      else BreakerPolicy())
+        self.max_queue_depth = max_queue_depth
+        self.default_deadline_s = default_deadline_s
+        self.degrade_to_solo = degrade_to_solo
+        self._sleep = sleep
+        self._has_deadlines = False   # gates the per-pump queue scan
+        self._attempts = 0      # dispatch-attempt counter = the fault
+        #                         schedule's index (service/faults.py)
         self._queues: dict[tuple, deque] = {}
         self._handles: dict[int, RequestHandle] = {}
         self._filler: dict[tuple, SimConfig] = {}
         self._next_rid = 0
         self._completed = 0
+        self._failed = 0
         # service aggregates over a bounded sliding window: a
         # long-lived stream must not grow host memory per request, so
         # stats() percentiles/means describe the last ``stats_window``
@@ -115,24 +157,56 @@ class FleetService:
         self._dispatches: deque = deque(maxlen=max(1, stats_window // 8))
         self._dispatch_count = 0
         self._bucket_stats: dict[tuple, dict] = {}
+        # failure-domain counters (lifetime-exact, like the request/
+        # dispatch counters; the windowed view rides the _dispatches
+        # entries' "retries" field)
+        self._failures = {
+            "retries": 0, "backoff_s": 0.0, "deadline_misses": 0,
+            "shed": 0, "breaker_opens": 0, "degraded_dispatches": 0,
+            "degraded_requests": 0, "failed_requests": 0,
+            "device_losses": 0, "mesh_rebuilds": 0,
+            "faults_injected": 0, "poisoned_lanes": 0,
+            "injected_latency_s": 0.0,
+        }
 
     # ---- admission ---------------------------------------------------
     def submit(self, cfg: SimConfig, seed: Optional[int] = None,
-               mode: str = "trace") -> RequestHandle:
+               mode: str = "trace",
+               deadline_s: Optional[float] = None) -> RequestHandle:
         """Admit one simulation request; returns immediately.
 
         ``seed`` is sugar for ``cfg.replace(seed=seed)``.  Admission
         also runs the cooperative flush pass, so a submit can complete
         earlier requests (its own too, when it fills a batch).
+
+        ``deadline_s`` (or the service's ``default_deadline_s``) is a
+        relative latency budget on the service clock: a request still
+        queued past it fails fast with :class:`DeadlineExceeded`; one
+        that completes late is delivered with
+        ``metrics.deadline_missed`` set.  When the queue already holds
+        ``max_queue_depth`` requests, admission sheds with the typed
+        :class:`ShedRejection` — load is never shed by silently
+        dropping something already queued.
         """
         if mode not in MODES:
             raise ValueError(f"unknown mode {mode!r}; expected one "
                              f"of {MODES}")
+        if self.max_queue_depth is not None \
+                and self.pending >= self.max_queue_depth:
+            self._failures["shed"] += 1
+            raise ShedRejection(self.pending, self.max_queue_depth)
         if seed is not None:
             cfg = cfg.replace(seed=int(seed))
         key = bucket_key(cfg, mode)
+        now = self.clock()
+        budget = deadline_s if deadline_s is not None \
+            else self.default_deadline_s
         req = SimRequest(rid=self._next_rid, cfg=cfg, mode=mode,
-                         bucket=key, submit_s=self.clock())
+                         bucket=key, submit_s=now,
+                         deadline_s=(now + budget
+                                     if budget is not None else None))
+        if req.deadline_s is not None:
+            self._has_deadlines = True
         self._next_rid += 1
         handle = RequestHandle(request=req, _service=self)
         self._handles[req.rid] = handle
@@ -159,6 +233,7 @@ class FleetService:
         """
         n = 0
         now = self.clock()
+        self._expire_deadlines(now)
         for key in list(self._queues):
             q = self._queues[key]
             while len(q) >= self.capacity:
@@ -173,6 +248,7 @@ class FleetService:
     def flush(self, bucket: Optional[tuple] = None) -> int:
         """Dispatch everything pending (in one bucket, or all)."""
         n = 0
+        self._expire_deadlines(self.clock())
         keys = [bucket] if bucket is not None else list(self._queues)
         for key in keys:
             while self._queues.get(key):
@@ -211,34 +287,132 @@ class FleetService:
             w = min(self.capacity, 1 << (k - 1).bit_length())
         else:
             w = self.capacity
+        # a mesh shrink mid-flight can leave an already-popped batch
+        # wider than the NEW capacity; the width must still cover it
+        w = max(w, k)
         d = self.n_devices
         return -(-w // d) * d
 
     def _dispatch(self, key: tuple) -> None:
+        """Pop one batch and resolve it ATOMICALLY: every popped
+        request reaches a terminal state (completed, degraded, or
+        failed on its handle) before this returns.  Only non-Exception
+        escapes (KeyboardInterrupt, SystemExit) re-queue the
+        still-unresolved requests at the queue front and propagate."""
         q = self._queues[key]
         reqs = [q.popleft() for _ in range(min(len(q), self.capacity))]
+        try:
+            self._serve_batch(key, reqs)
+        except BaseException:
+            unresolved = [r for r in reqs if r.rid in self._handles]
+            q.extendleft(reversed(unresolved))
+            raise
+
+    # ---- resilient dispatch (service/resilience.py) ------------------
+    def _serve_batch(self, key: tuple, reqs: list) -> None:
+        now = self.clock()
+        reqs = self._drop_expired(reqs, now)
+        if not reqs:
+            return
+        t_q0 = now              # queue wait ends at the first attempt
+        if not self.breaker.allow(key, now):
+            # quarantined bucket: straight to the ladder's bottom rung
+            self._degrade_batch(key, reqs, t_q0, retries=0)
+            return
+        attempt = 0
+        last_err: Optional[BaseException] = None
+        while True:
+            self._attempts += 1
+            idx = self._attempts
+            fault = (self.injector.plan(idx)
+                     if self.injector is not None else None)
+            if fault is not None:
+                self._failures["faults_injected"] += 1
+            builds0 = run_build_count()
+            t0 = self.clock()
+            try:
+                fleet, width = self._attempt(key, reqs, fault, idx)
+                wall = self.clock() - t0
+                builds = run_build_count() - builds0
+                self.breaker.record_success(key)
+                self._complete_batch(key, reqs, fleet, width, wall,
+                                     builds, t_q0, retries=attempt)
+                return
+            except InjectedDeviceLoss as e:
+                self._failures["device_losses"] += 1
+                if self.mesh is not None:
+                    self._degrade_mesh()
+                last_err = e
+            except Exception as e:
+                last_err = e
+            if self.breaker.record_failure(key, self.clock()):
+                self._failures["breaker_opens"] += 1
+            attempt += 1
+            now = self.clock()
+            reqs = self._drop_expired(reqs, now)
+            if not reqs:
+                return
+            backoff = self.retry.backoff_s(attempt, salt=idx)
+            remaining = self._min_remaining(reqs, now)
+            if attempt > self.retry.max_retries or \
+                    (remaining is not None and backoff >= remaining):
+                break
+            self._failures["retries"] += 1
+            self._failures["backoff_s"] += backoff
+            self._sleep(backoff)
+        # retries exhausted: degrade to the solo fallback (or fail
+        # terminally when the fallback is disabled)
+        self._degrade_batch(key, reqs, t_q0, retries=attempt,
+                            last_err=last_err)
+
+    def _attempt(self, key: tuple, reqs: list, fault: Optional[str],
+                 idx: int):
+        """One dispatch attempt, with the fault plane consulted at
+        each boundary; returns ``(fleet, width)`` or raises."""
+        if fault == "device_loss":
+            raise InjectedDeviceLoss(idx)
+        if fault == "compile":
+            # the program-build boundary, before the bucket handle is
+            # even looked up
+            raise InjectedCompileFailure(idx)
         cfgs = [r.cfg for r in reqs]
         width = self._width(len(cfgs))
         padded = pad_configs(cfgs, width, self._filler[key])
         sim = self.cache.get(key, cfgs[0])
-        builds0 = run_build_count()
-        t0 = self.clock()
-        try:
-            if reqs[0].mode == "bench":
-                fleet = sim.run_bench(configs=padded, warmup=False,
-                                      n_real=len(reqs))
-            else:
-                fleet = sim.run(configs=padded, n_real=len(reqs),
-                                warmup=False)
-        except BaseException:
-            # a failed dispatch must not strand its requests: put them
-            # back at the FRONT of the queue (arrival order preserved)
-            # so their handles can still complete on a retry/flush,
-            # and let the caller see the real error
-            q.extendleft(reversed(reqs))
-            raise
-        wall = self.clock() - t0
-        builds = run_build_count() - builds0
+        if fault == "dispatch":
+            raise InjectedDispatchFailure(idx)
+        if reqs[0].mode == "bench":
+            fleet = sim.run_bench(configs=padded, warmup=False,
+                                  n_real=len(reqs))
+        else:
+            fleet = sim.run(configs=padded, n_real=len(reqs),
+                            warmup=False)
+        if fault == "latency":
+            dt = self.injector.latency_s(idx)
+            self._failures["injected_latency_s"] += dt
+            self._sleep(dt)
+        if fault == "poison":
+            self.injector.poison(fleet, idx)
+            self._failures["poisoned_lanes"] += 1
+        # result validation: the filler-lane invariant first (a fleet
+        # must unstack exactly the real lanes — a mismatch would
+        # silently mispair requests and results in the zip below),
+        # then per-lane sanity (catches poisoned lanes)
+        if len(fleet.lanes) != len(reqs):
+            raise DispatchFailed(
+                reqs[0].rid, 1, RuntimeError(
+                    f"dispatch unstacked {len(fleet.lanes)} lanes for "
+                    f"{len(reqs)} requests; filler lanes must never "
+                    "be unstacked"))
+        for r, lane in zip(reqs, fleet.lanes):
+            why = validate_lane(r, lane)
+            if why is not None:
+                raise PoisonedLaneError(r.rid, why)
+        return fleet, width
+
+    def _complete_batch(self, key: tuple, reqs: list, fleet, width: int,
+                        wall: float, builds: int, t_q0: float,
+                        retries: int) -> None:
         occupancy = len(reqs) / width
         # split the dispatch wall: device-wait (program execution,
         # core/fleet.py times it around dispatch+block_until_ready) vs
@@ -248,23 +422,124 @@ class FleetService:
         device_wait = min(wall, float(fleet.device_seconds))
         now = self.clock()
         for req, lane in zip(reqs, fleet.lanes):
+            missed = req.deadline_s is not None and now > req.deadline_s
+            if missed:
+                self._failures["deadline_misses"] += 1
             self._handles.pop(req.rid)._complete(lane, RequestMetrics(
                 rid=req.rid, bucket=key, mode=req.mode,
-                queue_wait_s=t0 - req.submit_s, run_wall_s=wall,
+                queue_wait_s=t_q0 - req.submit_s, run_wall_s=wall,
                 latency_s=now - req.submit_s, batch=len(reqs),
                 padded_batch=width, occupancy=occupancy,
-                cache_hit=builds == 0, builds=builds))
+                cache_hit=builds == 0, builds=builds, retries=retries,
+                deadline_missed=missed))
             self._latencies.append(now - req.submit_s)
         self._completed += len(reqs)
         self._dispatches.append({"bucket": key, "batch": len(reqs),
                                  "width": width, "occupancy": occupancy,
                                  "wall_s": wall, "builds": builds,
                                  "device_wait_s": device_wait,
-                                 "host_s": max(0.0, wall - device_wait)})
+                                 "host_s": max(0.0, wall - device_wait),
+                                 "retries": retries})
         self._dispatch_count += 1
         bs = self._bucket_stats[key]
         bs["dispatches"] += 1
         bs["builds"] += builds
+
+    def _degrade_batch(self, key: tuple, reqs: list, t_q0: float,
+                       retries: int,
+                       last_err: Optional[BaseException] = None) -> None:
+        """The degradation ladder's bottom rung: serve each request by
+        a direct solo run (service/resilience.py ``solo_run``).  When
+        ``degrade_to_solo`` is off — or a solo run itself fails — the
+        request fails terminally with a typed DispatchFailed instead;
+        either way no handle is left pending."""
+        self._failures["degraded_dispatches"] += 1
+        if last_err is None:
+            last_err = BucketQuarantined(key)
+        for req in reqs:
+            if not self.degrade_to_solo:
+                self._fail_request(req, DispatchFailed(
+                    req.rid, max(retries, 1), last_err), cause=last_err)
+                continue
+            t0 = self.clock()
+            try:
+                res = solo_run(req)
+            except Exception as e:
+                self._fail_request(req, DispatchFailed(
+                    req.rid, retries + 1, e), cause=e)
+                continue
+            now = self.clock()
+            missed = req.deadline_s is not None and now > req.deadline_s
+            if missed:
+                self._failures["deadline_misses"] += 1
+            self._failures["degraded_requests"] += 1
+            self._handles.pop(req.rid)._complete(res, RequestMetrics(
+                rid=req.rid, bucket=key, mode=req.mode,
+                queue_wait_s=t_q0 - req.submit_s,
+                run_wall_s=now - t0, latency_s=now - req.submit_s,
+                batch=1, padded_batch=1, occupancy=1.0,
+                cache_hit=False, builds=0, retries=retries,
+                degraded=True, deadline_missed=missed))
+            self._latencies.append(now - req.submit_s)
+            self._completed += 1
+
+    def _degrade_mesh(self) -> None:
+        """One rung down the ladder: drop a device from the lane mesh
+        (to no mesh at all below two devices) and rebind the program
+        cache, so the bucket's next attempt rebuilds on the smaller
+        mesh through the existing mesh-keyed caches — sibling buckets
+        on other services keep their programs (eviction is per-handle
+        exact, core/fleet.py ``evict_programs``)."""
+        from ..parallel.fleet_mesh import shrink_mesh
+        self.mesh = shrink_mesh(self.mesh)
+        self.n_devices = (int(self.mesh.devices.size)
+                          if self.mesh is not None else 1)
+        self.cache.rebind_mesh(self.mesh)
+        self._failures["mesh_rebuilds"] += 1
+
+    def _fail_request(self, req, error: BaseException,
+                      cause: Optional[BaseException] = None) -> None:
+        if cause is not None and error.__cause__ is None:
+            error.__cause__ = cause
+        self._failed += 1
+        self._failures["failed_requests"] += 1
+        self._handles.pop(req.rid)._fail(error)
+
+    def _drop_expired(self, reqs: list, now: float) -> list:
+        """Fail (terminally, typed) the requests whose deadline has
+        passed; returns the still-live ones."""
+        live = []
+        for r in reqs:
+            if r.deadline_s is not None and now >= r.deadline_s:
+                self._failures["deadline_misses"] += 1
+                self._fail_request(r, DeadlineExceeded(
+                    r.rid, now - r.submit_s, r.deadline_s - r.submit_s))
+            else:
+                live.append(r)
+        return live
+
+    def _expire_deadlines(self, now: float) -> None:
+        """Queue-side deadline expiry (pump/flush): a request that can
+        no longer make its deadline fails fast instead of wasting a
+        lane.  Free until the first deadline-carrying request is
+        admitted — a deadline-less service never pays the queue scan
+        on its admission path."""
+        if not self._has_deadlines:
+            return
+        for key in list(self._queues):
+            q = self._queues[key]
+            if not q or all(r.deadline_s is None for r in q):
+                continue
+            live = self._drop_expired(list(q), now)
+            if len(live) != len(q):
+                q.clear()
+                q.extend(live)
+
+    @staticmethod
+    def _min_remaining(reqs: list, now: float) -> Optional[float]:
+        rem = [r.deadline_s - now for r in reqs
+               if r.deadline_s is not None]
+        return min(rem) if rem else None
 
     # ---- warm + metrics ----------------------------------------------
     def warm(self, cfg: SimConfig, mode: str = "trace") -> None:
@@ -319,6 +594,7 @@ class FleetService:
         out = {
             "requests": self._next_rid,
             "completed": self._completed,
+            "failed": self._failed,
             "pending": self.pending,
             "dispatches": self._dispatch_count,
             "mean_occupancy": round(float(occ.mean()), 4) if occ.size else 0.0,
@@ -341,6 +617,13 @@ class FleetService:
             "pad_policy": self.pad_policy,
             "devices": self.n_devices,
             "capacity": self.capacity,
+            # the failure domain (PR 5): lifetime-exact counters like
+            # requests/dispatches above; the windowed per-dispatch
+            # view carries "retries" in each _dispatches entry
+            "failures": {k: (round(v, 6) if isinstance(v, float) else v)
+                         for k, v in self._failures.items()},
+            "breaker_open_buckets":
+                self.breaker.open_buckets(self.clock()),
         }
         out["buckets"] = {repr(k): dict(v)
                           for k, v in self._bucket_stats.items()}

@@ -29,6 +29,11 @@ class SimRequest:
     mode: str             # one of MODES
     bucket: tuple         # compatibility key (service/bucket.py)
     submit_s: float       # service clock at admission
+    #: absolute service-clock deadline (None: no deadline).  Queued
+    #: requests past it fail fast with DeadlineExceeded; dispatched
+    #: requests that complete late are accounted in
+    #: ``RequestMetrics.deadline_missed`` — never silently dropped.
+    deadline_s: Optional[float] = None
 
 
 @dataclass
@@ -53,6 +58,17 @@ class RequestMetrics:
     occupancy: float      # batch / padded_batch
     cache_hit: bool
     builds: int           # whole-run builds this dispatch triggered
+    #: failed dispatch attempts this request's batch survived before
+    #: completing (0 on the clean path)
+    retries: int = 0
+    #: True when the request was served by the solo-run fallback (the
+    #: degradation ladder's bottom rung, service/resilience.py) rather
+    #: than a batched fleet program
+    degraded: bool = False
+    #: True when the request completed AFTER its deadline (the result
+    #: is still delivered; expiry BEFORE dispatch fails the handle
+    #: with DeadlineExceeded instead)
+    deadline_missed: bool = False
 
 
 @dataclass
@@ -65,36 +81,71 @@ class RequestHandle:
     (tests/test_service.py).  If the request is still queued,
     ``result()`` flushes its bucket first, so it never deadlocks on a
     partial batch that would otherwise wait for ``max_wait``.
+
+    Every handle reaches a TERMINAL state — ``completed``,
+    ``degraded`` (served by the solo-run fallback), or ``failed``
+    (``result()`` re-raises the typed error: DeadlineExceeded,
+    DispatchFailed, ... — service/resilience.py).  The scheduler's
+    dispatch path is atomic about this: a request popped for a
+    dispatch is never left ``pending`` with no owner, whatever the
+    dispatch did (tests/test_resilience.py).
     """
 
     request: SimRequest
     _service: "FleetService" = field(repr=False)  # noqa: F821
     _result: Optional[object] = field(default=None, repr=False)
     _metrics: Optional[RequestMetrics] = field(default=None, repr=False)
+    _error: Optional[BaseException] = field(default=None, repr=False)
 
     @property
     def done(self) -> bool:
-        return self._metrics is not None
+        """Terminal (completed, degraded, or failed)."""
+        return self._metrics is not None or self._error is not None
+
+    @property
+    def failed(self) -> bool:
+        return self._error is not None
+
+    @property
+    def status(self) -> str:
+        """``pending`` | ``completed`` | ``degraded`` | ``failed``."""
+        if self._error is not None:
+            return "failed"
+        if self._metrics is not None:
+            return "degraded" if self._metrics.degraded else "completed"
+        return "pending"
+
+    def exception(self) -> Optional[BaseException]:
+        """The terminal error (None unless :attr:`failed`)."""
+        return self._error
 
     def result(self):
         if not self.done:
             self._service.flush(self.request.bucket)
+        if self._error is not None:
+            raise self._error
         if not self.done:
-            # reachable only if a flush dispatched and failed (the
-            # scheduler re-queues the batch then re-raises, so the
-            # caller normally sees the dispatch error first)
+            # unreachable through the scheduler's atomic dispatch path
+            # (every popped request is terminally resolved); kept as a
+            # guard against interrupted flushes (KeyboardInterrupt
+            # re-queues the batch and propagates)
             raise RuntimeError(
                 f"request {self.request.rid} is still pending after a "
-                "flush of its bucket; a previous dispatch of this "
-                "bucket failed — fix the error and flush again")
+                "flush of its bucket; the flush was interrupted — "
+                "flush again")
         return self._result
 
     @property
     def metrics(self) -> RequestMetrics:
         if not self.done:
             self.result()
+        if self._error is not None:
+            raise self._error
         return self._metrics
 
     def _complete(self, result, metrics: RequestMetrics) -> None:
         self._result = result
         self._metrics = metrics
+
+    def _fail(self, error: BaseException) -> None:
+        self._error = error

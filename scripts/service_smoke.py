@@ -9,12 +9,24 @@ Modes:
     python scripts/service_smoke.py quick             # small functional pass
     python scripts/service_smoke.py sweep             # max_batch sweep
     python scripts/service_smoke.py mesh [34]         # replay per device count
+    python scripts/service_smoke.py chaos [34] [0.12] # seeded fault sweep
 
 ``mesh`` re-runs the acceptance replay served from a lane mesh
 (parallel/fleet_mesh.py) at each D in {1, 2, 4, 8} with EQUAL total
 lane width (max_batch = 8/D per device) — the PERF §10 serving curve;
 8 virtual CPU devices are forced before jax imports, mirroring
 tests/conftest.py.
+
+``chaos`` replays the same acceptance stream under SEEDED fault
+schedules (service/faults.py; docs/SERVING.md "Failure model"): for
+each fault seed it injects ~``fault_rate`` dispatch-boundary faults
+plus one mid-replay device loss (the stream is served from a 2-device
+lane mesh when virtual devices allow, so the loss exercises the full
+degradation ladder mesh -> single device -> solo), then prints a
+completion / degradation / p95 table.  The first seed is replayed
+TWICE and its fault-sequence and per-request-outcome digests must
+match — chaos runs are regression tests, not flakes.  The sequential
+parity baseline is computed once and shared across every row.
 
 ``replay`` builds the acceptance stream — the three grader scenario
 kinds x two size tiers (the exact dense N=10 course scenarios, plus
@@ -36,7 +48,7 @@ import json
 import os
 import sys
 
-if "mesh" in sys.argv[1:2]:
+if sys.argv[1:2] and sys.argv[1] in ("mesh", "chaos"):
     # virtual devices must be forced before jax is first imported
     os.environ["JAX_PLATFORMS"] = "cpu"
     _flags = os.environ.get("XLA_FLAGS", "")
@@ -48,7 +60,8 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
-from gossip_protocol_tpu.service import (grader_templates,  # noqa: E402
+from gossip_protocol_tpu.service import (chaos_replay,  # noqa: E402
+                                         grader_templates,
                                          overlay_templates, replay)
 
 
@@ -104,6 +117,53 @@ def main(argv) -> int:
                   f"device-wait frac {m['device_wait_frac']:.2f}",
                   flush=True)
         return 0
+    elif mode == "chaos":
+        from gossip_protocol_tpu.parallel.fleet_mesh import make_lane_mesh
+        seeds = int(argv[1]) if len(argv) > 1 else 34
+        rate = float(argv[2]) if len(argv) > 2 else 0.12
+        mesh_d = 2 if jax.device_count() >= 2 else 1
+        tpls = _templates(512, 96)
+        print(f"chaos sweep: {seeds * len(tpls)} requests/seed, "
+              f"fault_rate={rate}, mesh D={mesh_d} + one device loss",
+              flush=True)
+        seq = None
+        rows = []
+        for i, fseed in enumerate((7, 19, 23)):
+            mesh = make_lane_mesh(mesh_d) if mesh_d > 1 else None
+            kw = dict(seeds_per_template=seeds, max_batch=8 // mesh_d,
+                      mesh=mesh, fault_seed=fseed, fault_rate=rate)
+            if seq is None:
+                m, seq = chaos_replay(tpls, return_legs=True, **kw)
+            else:
+                m = chaos_replay(tpls, sequential=seq, **kw)
+            rows.append(m)
+            fs = m["faults"]
+            print(f"seed={fseed:3d}: faults={fs['total']:2d} "
+                  f"(c{fs['compile']}/d{fs['dispatch']}/l{fs['latency']}"
+                  f"/p{fs['poison']}/D{fs['device_loss']}), "
+                  f"completed {m['completed']}/{m['requests']}, "
+                  f"degraded {m['degraded_requests']}, "
+                  f"retries {m['failures']['retries']}, "
+                  f"devices {m['devices_start']}->{m['devices_end']}, "
+                  f"p95 {m['latency_p95_s']:.2f}s, "
+                  f"{m['speedup_vs_sequential']:.2f}x sequential",
+                  flush=True)
+        # replayability: the first seed again, digest-for-digest
+        mesh = make_lane_mesh(mesh_d) if mesh_d > 1 else None
+        m2 = chaos_replay(tpls, seeds_per_template=seeds,
+                          max_batch=8 // mesh_d, mesh=mesh, fault_seed=7,
+                          fault_rate=rate, sequential=seq)
+        reproduced = (m2["schedule_digest"] == rows[0]["schedule_digest"]
+                      and m2["outcome_digest"] == rows[0]["outcome_digest"])
+        ok = (all(r["completion_rate"] == 1.0 for r in rows)
+              and reproduced)
+        print(f"acceptance: completion=100% "
+              f"{'OK' if all(r['completion_rate'] == 1.0 for r in rows) else 'FAIL'}, "
+              f"0 stranded OK (enforced), parity OK (enforced), "
+              f"seed replay {'OK' if reproduced else 'FAIL'} "
+              f"(schedule {m2['schedule_digest']}, "
+              f"outcomes {m2['outcome_digest']})", flush=True)
+        return 0 if ok else 1
     elif mode == "replay":
         seeds = int(argv[1]) if len(argv) > 1 else 34
         n = int(argv[2]) if len(argv) > 2 else 512

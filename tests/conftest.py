@@ -48,6 +48,10 @@ def pytest_configure(config):
         "markers", "service: serving-layer tests (select the fast "
         "service path with -m service; the full mixed-trace replay is "
         "additionally marked slow and runs outside tier-1)")
+    config.addinivalue_line(
+        "markers", "resilience: serving failure-model tests (fault "
+        "injection, retry/deadline/breaker, mesh degradation; the "
+        "full 204-request chaos replay is additionally marked slow)")
 
 
 @pytest.fixture(scope="session")

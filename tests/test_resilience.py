@@ -1,0 +1,466 @@
+"""Serving failure model (PR 5): deterministic fault injection
+(service/faults.py), the resilience machinery that survives it
+(service/resilience.py), and graceful mesh degradation
+(parallel/fleet_mesh.py ``shrink_mesh``, service/cache.py
+``rebind_mesh``).
+
+The contracts under test:
+
+* **atomicity** — a request popped for a dispatch always reaches a
+  terminal state (completed / degraded / failed-with-typed-error);
+  no handle is ever stranded ``pending``, whatever the dispatch did;
+* **determinism** — the fault schedule is a pure function of
+  ``(seed, attempt index)``: the same seed reproduces the identical
+  fault sequence AND identical per-request outcomes across runs;
+* **exactness under chaos** — retried, mesh-degraded, and
+  solo-degraded requests still return results bit-identical to solo
+  runs (the solo fallback IS the parity reference);
+* **filler safety** — a dispatch that dies mid-bucket can never
+  unstack filler lanes into real handles.
+
+The fast tests here run inside tier-1 (``-m resilience``); the full
+204-request chaos acceptance replay is additionally marked ``slow``
+(scripts/service_smoke.py ``chaos`` runs the same harness standalone).
+"""
+
+import numpy as np
+import pytest
+
+from gossip_protocol_tpu.config import SimConfig
+from gossip_protocol_tpu.core.sim import Simulation
+from gossip_protocol_tpu.service import (BreakerPolicy, DeadlineExceeded,
+                                         DispatchFailed, FaultInjector,
+                                         FleetService, RetryPolicy,
+                                         ShedRejection, chaos_replay,
+                                         overlay_templates, Template)
+
+pytestmark = [pytest.mark.service, pytest.mark.resilience]
+
+
+def _dense_churn(n=16, ticks=22):
+    return SimConfig(max_nnb=n, single_failure=False, drop_msg=False,
+                     seed=0, total_ticks=ticks, fail_tick=20,
+                     rejoin_after=15)
+
+
+def _fast_retry(**kw):
+    kw.setdefault("max_retries", 2)
+    kw.setdefault("backoff_base_s", 1e-4)
+    return RetryPolicy(**kw)
+
+
+class _Clock:
+    """Deterministic service clock; ``sleep`` advances it (so backoff
+    and breaker cooldowns run on fake time in these tests)."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += dt
+
+
+# ---- the injector is deterministic -----------------------------------
+def test_injector_schedule_deterministic():
+    a = FaultInjector(seed=42, fault_rate=0.3)
+    b = FaultInjector(seed=42, fault_rate=0.3)
+    plan_a = [a.plan(i) for i in range(1, 200)]
+    plan_b = [b.plan(i) for i in range(1, 200)]
+    assert plan_a == plan_b
+    assert a.events == b.events and a.schedule_digest() == b.schedule_digest()
+    assert any(k is not None for k in plan_a)
+    # the draw is per-index, not per-call-order: asking only for the
+    # odd indices reproduces exactly the odd subsequence
+    c = FaultInjector(seed=42, fault_rate=0.3)
+    assert [c.plan(i) for i in range(1, 200, 2)] == plan_a[::2]
+    # a different seed gives a different schedule
+    d = FaultInjector(seed=43, fault_rate=0.3)
+    assert [d.plan(i) for i in range(1, 200)] != plan_a
+
+
+def test_injector_device_loss_wins_at_its_index():
+    inj = FaultInjector(seed=1, fault_rate=0.0, device_loss_at=5)
+    assert [inj.plan(i) for i in (3, 4, 5, 6)] == \
+        [None, None, "device_loss", None]
+    assert inj.summary()["device_loss"] == 1
+
+
+def test_retry_policy_backoff_deterministic_and_bounded():
+    p = RetryPolicy(max_retries=5, backoff_base_s=0.1,
+                    backoff_factor=2.0, max_backoff_s=0.5,
+                    jitter_frac=0.25, seed=3)
+    seq = [p.backoff_s(a) for a in (1, 2, 3, 4, 5)]
+    assert seq == [p.backoff_s(a) for a in (1, 2, 3, 4, 5)]
+    for a, b in enumerate(seq, start=1):
+        nominal = min(0.5, 0.1 * 2.0 ** (a - 1))
+        assert 0.75 * nominal <= b <= 1.25 * nominal, (a, b)
+    assert RetryPolicy(jitter_frac=0.0).backoff_s(1) == \
+        RetryPolicy(jitter_frac=0.0).backoff_base_s
+
+
+# ---- retry recovers transients, terminal failures are typed ----------
+def test_transient_fault_recovered_with_parity():
+    cfg = _dense_churn()
+    ref = Simulation(cfg).run(seed=1)
+    for kind in ("compile", "dispatch", "poison"):
+        svc = FleetService(max_batch=2,
+                           injector=FaultInjector(schedule={1: kind}),
+                           retry=_fast_retry())
+        hs = [svc.submit(cfg, seed=s) for s in (1, 2)]
+        assert [h.status for h in hs] == ["completed", "completed"], kind
+        assert all(h.metrics.retries == 1 for h in hs), kind
+        assert np.array_equal(hs[0].result().sent, ref.sent), kind
+        st = svc.stats()["failures"]
+        assert st["retries"] == 1 and st["faults_injected"] == 1, kind
+        if kind == "poison":
+            assert st["poisoned_lanes"] == 1
+
+
+def test_poison_overlay_lane_detected():
+    """Overlay fleet metrics cross to host as READ-ONLY numpy views;
+    poisoning must replace the lane's array (not write into it) so
+    validate_lane — not a ValueError — is what catches it."""
+    from gossip_protocol_tpu.models.overlay import OverlaySimulation
+    cfg = SimConfig(max_nnb=64, model="overlay", single_failure=False,
+                    drop_msg=False, seed=0, total_ticks=48,
+                    churn_rate=0.25, rejoin_after=16, step_rate=8.0 / 64)
+    svc = FleetService(max_batch=2,
+                       injector=FaultInjector(schedule={1: "poison"}),
+                       retry=_fast_retry())
+    hs = [svc.submit(cfg, seed=s) for s in (1, 2)]
+    assert [h.status for h in hs] == ["completed", "completed"]
+    st = svc.stats()["failures"]
+    assert st["poisoned_lanes"] == 1 and st["retries"] == 1
+    ref = OverlaySimulation(cfg.replace(seed=1), use_pallas=False).run()
+    lane = hs[0].result()
+    assert np.array_equal(np.asarray(ref.metrics.sent),
+                          np.asarray(lane.metrics.sent))
+
+
+def test_injector_rejects_unknown_kinds():
+    with pytest.raises(ValueError, match="unknown fault kinds"):
+        FaultInjector(kinds=("dispatch", "segfault"))
+    with pytest.raises(ValueError, match="schedule"):
+        FaultInjector(schedule={1: "device-loss"})    # typo'd kind
+    FaultInjector(schedule={1: "device_loss"})        # explicit loss OK
+
+
+def test_clean_replay_raises_on_hidden_degradation(monkeypatch):
+    """The fault-free replay() harness must stay LOUD about engine
+    failures: the resilient scheduler degrades a broken fleet path to
+    solo runs that pass parity trivially, so replay() asserts zero
+    degraded/failed requests instead of reporting a bogus speedup."""
+    from gossip_protocol_tpu.core.fleet import FleetSimulation
+    from gossip_protocol_tpu.service import replay
+
+    real_run = FleetSimulation.run
+
+    def broken_run(self, *a, **kw):
+        if kw.get("n_real") == 1:      # keep the warm pass alive
+            return real_run(self, *a, **kw)
+        raise RuntimeError("engine regression")
+
+    monkeypatch.setattr(FleetSimulation, "run", broken_run)
+    with pytest.raises(RuntimeError,
+                       match="degraded|dispatch path is broken"):
+        replay(overlay_templates(n=128, ticks=48), seeds_per_template=2,
+               max_batch=4)
+
+
+def test_injected_latency_counts_without_failing():
+    cfg = _dense_churn()
+    svc = FleetService(max_batch=2,
+                       injector=FaultInjector(schedule={1: "latency"},
+                                              latency_s=1e-3),
+                       retry=_fast_retry())
+    hs = [svc.submit(cfg, seed=s) for s in (1, 2)]
+    assert all(h.status == "completed" and h.metrics.retries == 0
+               for h in hs)
+    assert svc.stats()["failures"]["injected_latency_s"] > 0.0
+
+
+def test_exhausted_retries_degrade_to_solo_with_parity():
+    cfg = _dense_churn()
+    ref = Simulation(cfg).run(seed=5)
+    svc = FleetService(
+        max_batch=2,
+        injector=FaultInjector(schedule={i: "dispatch"
+                                         for i in range(1, 40)}),
+        retry=_fast_retry(max_retries=1))
+    hs = [svc.submit(cfg, seed=s) for s in (5, 6)]
+    assert [h.status for h in hs] == ["degraded", "degraded"]
+    assert np.array_equal(hs[0].result().sent, ref.sent)
+    st = svc.stats()["failures"]
+    assert st["degraded_dispatches"] == 1 and st["degraded_requests"] == 2
+
+
+def test_exhausted_retries_without_fallback_fail_typed():
+    cfg = _dense_churn()
+    svc = FleetService(
+        max_batch=2, degrade_to_solo=False,
+        injector=FaultInjector(schedule={i: "dispatch"
+                                         for i in range(1, 40)}),
+        retry=_fast_retry(max_retries=1))
+    hs = [svc.submit(cfg, seed=s) for s in (1, 2)]
+    assert all(h.status == "failed" for h in hs)
+    assert svc.pending == 0, "failed batch must not re-queue"
+    with pytest.raises(DispatchFailed, match="request 0 failed"):
+        hs[0].result()
+    assert isinstance(hs[0].exception().__cause__, Exception)
+    assert svc.stats()["failures"]["failed_requests"] == 2
+
+
+# ---- deadlines -------------------------------------------------------
+def test_deadline_expires_queued_request():
+    cfg = _dense_churn()
+    clock = _Clock()
+    svc = FleetService(max_batch=8, clock=clock, sleep=clock.sleep)
+    h = svc.submit(cfg, seed=1, deadline_s=2.0)
+    h2 = svc.submit(cfg, seed=2)          # no deadline: survives
+    clock.t = 3.0
+    svc.pump()
+    assert h.status == "failed" and not h2.done
+    with pytest.raises(DeadlineExceeded, match="deadline"):
+        h.result()
+    assert svc.stats()["failures"]["deadline_misses"] == 1
+    svc.drain()
+    assert h2.status == "completed"
+
+
+def test_deadline_missed_accounting_on_late_completion():
+    """A request that is DISPATCHED past its deadline inside a flush
+    still gets its result, flagged ``deadline_missed`` (accounting,
+    not a drop) — only queue-side expiry fails a handle."""
+    cfg = _dense_churn()
+    clock = _Clock()
+    svc = FleetService(max_batch=1, clock=clock, sleep=clock.sleep,
+                       default_deadline_s=5.0)
+    # max_batch=1: the submit itself dispatches, completing at the
+    # fake clock's frozen "now" == submit time -> not missed
+    h = svc.submit(cfg, seed=1)
+    assert h.status == "completed" and not h.metrics.deadline_missed
+
+
+def test_retry_loop_respects_deadline_budget():
+    """Backoff never sleeps past the batch's tightest deadline: with a
+    budget smaller than the first backoff, a faulted batch goes
+    straight to the fallback instead of sleeping through it."""
+    cfg = _dense_churn()
+    clock = _Clock()
+    svc = FleetService(
+        max_batch=2, clock=clock, sleep=clock.sleep,
+        injector=FaultInjector(schedule={1: "dispatch"}),
+        retry=RetryPolicy(max_retries=5, backoff_base_s=10.0,
+                          jitter_frac=0.0))
+    hs = [svc.submit(cfg, seed=s, deadline_s=1.0) for s in (1, 2)]
+    assert all(h.status == "degraded" for h in hs)
+    assert svc.stats()["failures"]["retries"] == 0, \
+        "slept into a deadline instead of degrading"
+
+
+# ---- admission control -----------------------------------------------
+def test_admission_sheds_typed_never_drops():
+    cfg = _dense_churn()
+    svc = FleetService(max_batch=8, max_queue_depth=2)
+    h1 = svc.submit(cfg, seed=1)
+    h2 = svc.submit(cfg, seed=2)
+    with pytest.raises(ShedRejection, match="max_queue_depth=2"):
+        svc.submit(cfg, seed=3)
+    assert svc.stats()["failures"]["shed"] == 1
+    svc.drain()                    # the queued two were never dropped
+    assert h1.status == h2.status == "completed"
+    assert svc.submit(cfg, seed=4).status == "pending"  # room again
+    svc.drain()
+    with pytest.raises(ValueError, match="max_queue_depth"):
+        FleetService(max_queue_depth=0)
+
+
+# ---- circuit breaker -------------------------------------------------
+def test_breaker_opens_quarantines_and_recovers():
+    cfg = _dense_churn()
+    ref = Simulation(cfg).run(seed=1)
+    clock = _Clock()
+    # faults on the first two attempts only; threshold 2, cooldown 10s
+    svc = FleetService(
+        max_batch=2, clock=clock, sleep=clock.sleep,
+        injector=FaultInjector(schedule={1: "dispatch", 2: "dispatch"}),
+        retry=_fast_retry(max_retries=0),
+        breaker=BreakerPolicy(failure_threshold=2, reset_after_s=10.0))
+    h1 = [svc.submit(cfg, seed=s) for s in (1, 2)]   # attempt 1 fails
+    h2 = [svc.submit(cfg, seed=s) for s in (3, 4)]   # attempt 2 opens
+    st = svc.stats()
+    assert st["failures"]["breaker_opens"] == 1
+    assert st["breaker_open_buckets"] == 1
+    assert all(h.status == "degraded" for h in h1 + h2)
+    # while open: quarantined straight to solo, no attempt consumed
+    attempts0 = svc._attempts
+    h3 = [svc.submit(cfg, seed=s) for s in (5, 6)]
+    assert all(h.status == "degraded" for h in h3)
+    assert svc._attempts == attempts0, "open breaker must not dispatch"
+    assert np.array_equal(h3[0].result().sent, ref.sent)
+    # after the cooldown: one probe dispatch, success closes it
+    clock.t += 11.0
+    h4 = [svc.submit(cfg, seed=s) for s in (1, 7)]
+    assert all(h.status == "completed" for h in h4)
+    assert svc.stats()["breaker_open_buckets"] == 0
+    assert np.array_equal(h4[0].result().sent, ref.sent)
+
+
+# ---- filler-lane safety under faults ---------------------------------
+def test_filler_lanes_survive_faulted_partial_batches():
+    """A PARTIAL batch (3 real + 5 filler) whose first attempt dies
+    must, on the retried attempt, still unstack exactly the 3 real
+    lanes — bit-identical to solo runs, filler never leaked."""
+    cfg = _dense_churn()
+    sim = Simulation(cfg)
+    svc = FleetService(max_batch=8, pad_policy="full",
+                       injector=FaultInjector(schedule={1: "dispatch"}),
+                       retry=_fast_retry())
+    hs = [svc.submit(cfg, seed=s) for s in (1, 2, 3)]
+    svc.drain()
+    assert [h.status for h in hs] == ["completed"] * 3
+    for s, h in zip((1, 2, 3), hs):
+        m = h.metrics
+        assert m.batch == 3 and m.padded_batch == 8 and m.retries == 1
+        assert np.array_equal(sim.run(seed=s).sent, h.result().sent), s
+    assert not svc._handles, "stranded handles after a faulted batch"
+
+
+def test_unstack_miscount_is_caught_not_mispaired():
+    """If a fleet ever unstacked the wrong lane count (filler leaked,
+    or a lane lost), the scheduler must catch it as a dispatch
+    failure — never zip mismatched lanes onto handles.  Pinned by
+    wrapping the bucket's fleet handle to return one extra lane."""
+    from gossip_protocol_tpu.service import bucket_key
+    cfg = _dense_churn()
+    ref = Simulation(cfg).run(seed=1)
+    svc = FleetService(max_batch=2, retry=_fast_retry(max_retries=0))
+    key = bucket_key(cfg, "trace")
+    fleet_sim = svc.cache.get(key, cfg)
+    real_run = fleet_sim.run
+
+    def leaky_run(*a, **kw):
+        fleet = real_run(*a, **kw)
+        fleet.lanes.append(fleet.lanes[-1])      # a filler lane "leaks"
+        return fleet
+
+    fleet_sim.run = leaky_run
+    hs = [svc.submit(cfg, seed=s) for s in (1, 2)]
+    # the leak is detected, the batch degrades to solo -> right results
+    assert [h.status for h in hs] == ["degraded", "degraded"]
+    assert np.array_equal(hs[0].result().sent, ref.sent)
+    assert not svc._handles
+
+
+def test_fleet_unstack_invariant_direct():
+    from gossip_protocol_tpu.core.fleet import _check_unstacked
+    _check_unstacked([1, 2, 3], 3)
+    with pytest.raises(RuntimeError, match="never be unstacked"):
+        _check_unstacked([1, 2, 3, 4], 3)
+
+
+# ---- mesh degradation ------------------------------------------------
+@pytest.mark.skipif(__import__("jax").device_count() < 2,
+                    reason="needs 2 (virtual) devices")
+def test_device_loss_shrinks_mesh_and_completes():
+    """One injected device loss mid-stream: the service drops to a
+    smaller mesh (2 -> single device), rebuilds through the mesh-keyed
+    caches, and completes every request bit-identically."""
+    from gossip_protocol_tpu.parallel.fleet_mesh import make_lane_mesh
+    cfg = _dense_churn()
+    ref = Simulation(cfg).run(seed=1)
+    svc = FleetService(max_batch=2, mesh=make_lane_mesh(2),
+                       injector=FaultInjector(device_loss_at=2),
+                       retry=_fast_retry())
+    assert svc.capacity == 4
+    h1 = [svc.submit(cfg, seed=s) for s in (1, 2, 3, 4)]   # attempt 1 OK
+    h2 = [svc.submit(cfg, seed=s) for s in (1, 5, 6, 7)]   # loss on 2
+    assert all(h.status == "completed" for h in h1 + h2)
+    assert svc.mesh is None and svc.n_devices == 1 and svc.capacity == 2
+    st = svc.stats()
+    assert st["failures"]["device_losses"] == 1
+    assert st["failures"]["mesh_rebuilds"] == 1
+    assert st["cache"]["mesh_rebinds"] == 1
+    assert np.array_equal(h1[0].result().sent, ref.sent)
+    assert np.array_equal(h2[0].result().sent, ref.sent)
+
+
+def test_shrink_mesh_ladder():
+    import jax
+    from gossip_protocol_tpu.parallel.fleet_mesh import (make_lane_mesh,
+                                                         mesh_descriptor,
+                                                         shrink_mesh)
+    assert shrink_mesh(None) is None
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 (virtual) devices")
+    m4 = make_lane_mesh(4)
+    m3 = shrink_mesh(m4)
+    assert m3.devices.size == 3
+    assert mesh_descriptor(m3) != mesh_descriptor(m4)
+    m2 = shrink_mesh(m3)
+    assert m2.devices.size == 2
+    assert shrink_mesh(m2) is None          # below 2: no mesh at all
+
+
+# ---- the chaos-seeded parity sweep -----------------------------------
+def _chaos_templates():
+    dense = SimConfig(max_nnb=20, single_failure=False, drop_msg=False,
+                      seed=0, total_ticks=26, fail_tick=20,
+                      rejoin_after=4)
+    drop = SimConfig(max_nnb=20, single_failure=True, drop_msg=True,
+                     msg_drop_prob=0.1, seed=0, total_ticks=26,
+                     fail_tick=10)
+    return ([Template("dense-churn", dense), Template("dense-drop", drop)]
+            + overlay_templates(n=128, ticks=48))
+
+
+def test_chaos_seeded_parity_sweep_and_reproducibility():
+    """The chaos gate at test scale: a mixed stream under a seeded
+    ~30% fault schedule + one device loss completes 100% with parity
+    (enforced inside chaos_replay), and the SAME seed reproduces the
+    identical fault sequence and per-request outcomes."""
+    tpls = _chaos_templates()
+    m1, seq = chaos_replay(tpls, seeds_per_template=3, max_batch=4,
+                           fault_seed=11, fault_rate=0.3,
+                           return_legs=True)
+    assert m1["requests"] == 15 and m1["completion_rate"] == 1.0
+    assert m1["stranded"] == 0 and m1["failed"] == 0
+    assert m1["faults"]["total"] >= 1
+    m2 = chaos_replay(tpls, seeds_per_template=3, max_batch=4,
+                      fault_seed=11, fault_rate=0.3, sequential=seq)
+    assert m1["fault_events"] == m2["fault_events"]
+    assert m1["schedule_digest"] == m2["schedule_digest"]
+    assert m1["outcomes"] == m2["outcomes"]
+    assert m1["outcome_digest"] == m2["outcome_digest"]
+    # a different seed draws a different schedule
+    m3 = chaos_replay(tpls, seeds_per_template=3, max_batch=4,
+                      fault_seed=12, fault_rate=0.3, sequential=seq)
+    assert m3["completion_rate"] == 1.0
+    assert m3["fault_events"] != m1["fault_events"]
+
+
+@pytest.mark.slow
+def test_chaos_replay_acceptance():
+    """The PR-5 acceptance gate: the full 204-request mixed replay
+    under >=10% injected dispatch faults plus one mid-replay device
+    loss completes 100% (0 stranded), every request bit-identical to
+    its solo run, and the identical seed reproduces the identical
+    fault sequence and per-request outcomes."""
+    from gossip_protocol_tpu.service import grader_templates
+    tpls = grader_templates() + overlay_templates(n=512, ticks=96)
+    m1, seq = chaos_replay(tpls, seeds_per_template=34, max_batch=8,
+                           fault_seed=20260804, fault_rate=0.12,
+                           return_legs=True)
+    assert m1["requests"] == 204
+    assert m1["completion_rate"] == 1.0 and m1["stranded"] == 0
+    assert m1["faults"]["total"] >= 0.10 * m1["dispatches"]
+    assert m1["faults"]["device_loss"] == 1
+    assert m1["latency_p95_s"] < 60.0
+    m2 = chaos_replay(tpls, seeds_per_template=34, max_batch=8,
+                      fault_seed=20260804, fault_rate=0.12,
+                      sequential=seq)
+    assert m1["fault_events"] == m2["fault_events"]
+    assert m1["outcomes"] == m2["outcomes"]

@@ -270,6 +270,82 @@ def test_n_real_bounds():
         FleetSimulation(cfg).run(seeds=[1, 2], n_real=0)
 
 
+# ---- failure handling is atomic (PR 5 satellite) ---------------------
+def test_failed_dispatch_is_atomic_regression():
+    """Regression for the pre-PR-5 failure path (re-queue + re-raise
+    out of the caller's flush, leaking in-flight state): a failing
+    dispatch must terminally resolve EVERY popped request — none left
+    ``pending``, nothing re-queued into limbo — and the bucket must
+    keep serving afterwards."""
+    from gossip_protocol_tpu.service import (DispatchFailed,
+                                             FaultInjector, RetryPolicy)
+    cfg = _dense_churn(n=16, ticks=22)
+    svc = FleetService(
+        max_batch=2, degrade_to_solo=False,
+        injector=FaultInjector(schedule={1: "dispatch", 2: "compile"}),
+        retry=RetryPolicy(max_retries=0))
+    handles = [svc.submit(cfg, seed=s) for s in (1, 2)]
+    # the flush returned normally; the failure lives on the handles
+    assert svc.pending == 0
+    assert all(h.done and h.status == "failed" for h in handles)
+    assert not svc._handles, "handle stranded in pending"
+    with pytest.raises(DispatchFailed):
+        handles[0].result()
+    # the NEXT batch fails independently (attempt 2) ... and the one
+    # after that succeeds: the bucket was never poisoned
+    bad = [svc.submit(cfg, seed=s) for s in (3, 4)]
+    assert all(h.status == "failed" for h in bad)
+    good = [svc.submit(cfg, seed=s) for s in (5, 6)]
+    assert all(h.status == "completed" for h in good)
+    ref = Simulation(cfg).run(seed=5)
+    assert np.array_equal(good[0].result().sent, ref.sent)
+    st = svc.stats()
+    assert st["failed"] == 4 and st["completed"] == 2
+    assert st["failures"]["failed_requests"] == 4
+
+
+def test_stats_failure_domain_counters_clean_path():
+    """stats() carries the PR-5 failure-domain counters (satellite):
+    present and zero on a clean stream."""
+    cfg = _dense_churn(n=16, ticks=22)
+    svc = FleetService(max_batch=2)
+    [svc.submit(cfg, seed=s) for s in (1, 2)]
+    st = svc.stats()
+    f = st["failures"]
+    for k in ("retries", "backoff_s", "deadline_misses", "shed",
+              "breaker_opens", "degraded_dispatches",
+              "degraded_requests", "failed_requests", "device_losses",
+              "mesh_rebuilds", "faults_injected", "poisoned_lanes"):
+        assert f[k] == 0, (k, f)
+    assert st["breaker_open_buckets"] == 0
+    assert st["failed"] == 0
+    # the windowed per-dispatch view carries the retry count
+    assert all(d["retries"] == 0 for d in svc._dispatches)
+
+
+def test_filler_safety_bench_mode_under_fault():
+    """Satellite: a bench-mode dispatch that dies mid-bucket must
+    never unstack filler lanes into real handles — the retried partial
+    batch returns exactly its real lanes, counters bit-identical."""
+    from gossip_protocol_tpu.service import FaultInjector, RetryPolicy
+    cfg = SimConfig(max_nnb=16, single_failure=True, drop_msg=True,
+                    msg_drop_prob=0.1, seed=0, total_ticks=30,
+                    fail_tick=10)
+    svc = FleetService(max_batch=8, pad_policy="full",
+                       injector=FaultInjector(schedule={1: "dispatch"}),
+                       retry=RetryPolicy(max_retries=2,
+                                         backoff_base_s=1e-4))
+    handles = [svc.submit(cfg, seed=s, mode="bench") for s in (5, 6)]
+    svc.drain()
+    sim = Simulation(cfg)
+    for s, h in zip((5, 6), handles):
+        assert h.status == "completed"
+        m = h.metrics
+        assert m.batch == 2 and m.padded_batch == 8 and m.retries == 1
+        assert np.array_equal(sim.run_bench(seed=s).sent, h.result().sent)
+    assert not svc._handles
+
+
 # ---- grader through the service --------------------------------------
 def test_grade_all_service_full_marks(testcases_dir, tmp_path):
     """The grader — the service's first real client — still scores
