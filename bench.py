@@ -24,6 +24,7 @@ Baseline: the reference's measured best case is ~1.4M node-ticks/s
 
 import json
 import multiprocessing
+import os
 import sys
 
 REFERENCE_NODE_TICKS_PER_S = 1.4e6  # BASELINE.md best case, N=10, 1 CPU core
@@ -510,12 +511,17 @@ def main():
             return {
                 "requests": sv["requests"],
                 "devices": sv["devices"],
+                "pipeline": sv["pipeline"],
                 "speedup_vs_sequential": sv["speedup_vs_sequential"],
                 "aggregate_node_ticks_per_s":
                     sv["aggregate_node_ticks_per_s"],
                 "latency_p50_s": sv["latency_p50_s"],
                 "latency_p95_s": sv["latency_p95_s"],
                 "mean_occupancy": sv["mean_occupancy"],
+                # the PR-6 wall decomposition: pack / execute / fetch
+                "mean_pack_s": sv["mean_pack_s"],
+                "mean_device_wait_s": sv["mean_device_wait_s"],
+                "mean_fetch_s": sv["mean_fetch_s"],
                 "device_wait_frac": sv["device_wait_frac"],
                 "cache_hit_rate": sv["cache_hit_rate"],
                 "buckets": sv["buckets"],
@@ -651,7 +657,7 @@ def main():
 
     import jax
     nps = overlay.node_ticks_per_second
-    print(json.dumps({
+    payload = {
         "metric": f"node_ticks_per_s_n{n_overlay}_overlay_churn20",
         "value": round(nps, 1),
         "unit": "node-ticks/s",
@@ -667,7 +673,53 @@ def main():
         },
         "headline": _overlay_entry(overlay, backend),
         "secondary": secondary,
-    }))
+    }
+    print(json.dumps(payload))
+    if "--check" in sys.argv:
+        sys.exit(check_regression(payload))
+
+
+#: --check fails the run when the fresh headline falls more than this
+#: far below the latest recorded BENCH_pr*.json headline
+CHECK_REGRESSION_FRAC = 0.15
+
+
+def check_regression(payload: dict) -> int:
+    """Perf-gate mode (``bench.py --check``): compare the fresh run
+    against the LATEST recorded ``BENCH_pr*.json`` and return nonzero
+    on a >15% headline regression — so a perf-sensitive change can be
+    gated in one command instead of by eyeballing two jsons
+    (scripts/bench_trajectory.py renders the whole series).
+
+    Only same-metric headlines are compared: a ``--smoke`` run (or a
+    different backend's run) measures a different config, and a
+    comparison across metrics would gate on noise.
+    """
+    import glob
+    import re
+    baselines = sorted(
+        glob.glob(os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "BENCH_pr*.json")),
+        key=lambda p: int(re.search(r"BENCH_pr(\d+)", p).group(1)))
+    if not baselines:
+        print("bench --check: no BENCH_pr*.json baseline found",
+              file=sys.stderr)
+        return 2
+    ref = json.load(open(baselines[-1]))
+    if ref.get("metric") != payload["metric"]:
+        print(f"bench --check: metric mismatch (fresh "
+              f"{payload['metric']!r} vs baseline {ref.get('metric')!r} "
+              f"in {os.path.basename(baselines[-1])}); run the same "
+              "bench shape as the baseline", file=sys.stderr)
+        return 2
+    old, new = float(ref["value"]), float(payload["value"])
+    ratio = new / old if old else float("inf")
+    verdict = "OK" if ratio >= 1.0 - CHECK_REGRESSION_FRAC else "FAIL"
+    print(f"bench --check vs {os.path.basename(baselines[-1])}: "
+          f"{new:,.1f} vs {old:,.1f} nt/s ({(ratio - 1) * 100:+.1f}%) "
+          f"-> {verdict} (gate: -{CHECK_REGRESSION_FRAC:.0%})",
+          file=sys.stderr)
+    return 0 if verdict == "OK" else 1
 
 
 if __name__ == "__main__":

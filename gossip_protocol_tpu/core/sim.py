@@ -64,6 +64,29 @@ def _pack_sparse(added, removed, cap: int):
     return idx.astype(jnp.int32), flat[idx], nzw
 
 
+def _finish_masks_host(added, removed, idx, vals, nzw, cap: int):
+    """Host half of the sparse mask transfer: consume the compaction
+    outputs of :func:`_pack_sparse` (already computed on device — the
+    fleet's pipelined launch dispatches the compaction right after
+    the run program, core/fleet.py) and unpack to numpy.  Falls back
+    to the dense transfer of the original masks when the realized
+    nonzero count overflowed the sparse budget."""
+    c, n, _ = added.shape
+    nzw = int(nzw)
+    if nzw > cap:                       # denser than the sparse budget
+        return np.asarray(added), np.asarray(removed)
+    sl = 1 << max(10, (max(nzw, 1) - 1).bit_length())
+    sl = min(sl, cap)
+    pair = np.asarray(jnp.stack([idx[:sl], vals[:sl].astype(jnp.int32)]))
+    nw = (n + 31) // 32
+    words = np.zeros((2 * c * n * nw,), np.uint32)
+    words[pair[0, :nzw]] = pair[1, :nzw].astype(np.uint32)
+    bits = np.unpackbits(words.view(np.uint8).reshape(-1, 4), axis=1,
+                         bitorder="little")
+    both_h = bits.reshape(2 * c, n, nw * 32)[:, :, :n].astype(bool)
+    return both_h[:c], both_h[c:]
+
+
 def _masks_to_host(added, removed, cap: int):
     """Two (C, N, N) device bool masks -> host numpy, sparse when
     possible (one compaction pass over both — fewer relay dispatches).
@@ -78,19 +101,7 @@ def _masks_to_host(added, removed, cap: int):
     if c == 0 or n < 2:
         return np.asarray(added), np.asarray(removed)
     idx, vals, nzw = _pack_sparse(added, removed, cap=cap)
-    nzw = int(nzw)
-    if nzw > cap:                       # denser than the sparse budget
-        return np.asarray(added), np.asarray(removed)
-    sl = 1 << max(10, (max(nzw, 1) - 1).bit_length())
-    sl = min(sl, cap)
-    pair = np.asarray(jnp.stack([idx[:sl], vals[:sl].astype(jnp.int32)]))
-    nw = (n + 31) // 32
-    words = np.zeros((2 * c * n * nw,), np.uint32)
-    words[pair[0, :nzw]] = pair[1, :nzw].astype(np.uint32)
-    bits = np.unpackbits(words.view(np.uint8).reshape(-1, 4), axis=1,
-                         bitorder="little")
-    both_h = bits.reshape(2 * c, n, nw * 32)[:, :, :n].astype(bool)
-    return both_h[:c], both_h[c:]
+    return _finish_masks_host(added, removed, idx, vals, nzw, cap)
 
 
 @dataclass

@@ -233,26 +233,35 @@ def make_overlay_schedule(cfg: SimConfig) -> OverlaySchedule:
         else:
             victim_lo = (int(u * n) % n) // 2
             victim_hi = victim_lo + n // 2
+    # numpy scalars, deliberately: a schedule build must dispatch ZERO
+    # eager device ops.  Eager ``jnp`` scalar creation is a tiny XLA
+    # program each; on the serving path a fleet program is often in
+    # flight on the same device, and once the client's bounded
+    # in-flight queue fills, the next tiny dispatch BLOCKS until the
+    # big program finishes — which silently serialized the pipelined
+    # scheduler's pack step behind the very execution it was supposed
+    # to overlap (docs/PERF.md §11).  The values are identical; they
+    # enter device code as jit inputs exactly as before.
     return OverlaySchedule(
-        seed=jnp.uint32(cfg.seed & 0xFFFFFFFF),
-        step_num=jnp.int32(step_num),
-        step_den=jnp.int32(step_den),
-        victim_lo=jnp.int32(victim_lo),
-        victim_hi=jnp.int32(victim_hi),
-        fail_tick=jnp.int32(cfg.fail_tick),
-        rejoin_after=jnp.int32(cfg.rejoin_after
-                               if cfg.rejoin_after is not None else NEVER),
-        churn_thr=jnp.uint32(threshold32(cfg.churn_rate)
-                             if cfg.churn_rate > 0 else 0),
-        churn_lo=jnp.int32(cfg.total_ticks // 4),
-        churn_span=jnp.int32(max(cfg.total_ticks // 2, 1)),
-        churn_after=jnp.int32(cfg.rejoin_after
-                              if cfg.rejoin_after is not None else 40),
-        drop_on=jnp.asarray(bool(cfg.drop_msg)),
-        drop_open=jnp.int32(cfg.drop_open_tick),
-        drop_close=jnp.int32(cfg.drop_close_tick),
-        drop_thr=jnp.uint32(threshold32(cfg.msg_drop_prob)),
-        deg_thr=jnp.asarray(degree_thresholds(cfg, resolved_dims(cfg)[1])),
+        seed=np.uint32(cfg.seed & 0xFFFFFFFF),
+        step_num=np.int32(step_num),
+        step_den=np.int32(step_den),
+        victim_lo=np.int32(victim_lo),
+        victim_hi=np.int32(victim_hi),
+        fail_tick=np.int32(cfg.fail_tick),
+        rejoin_after=np.int32(cfg.rejoin_after
+                              if cfg.rejoin_after is not None else NEVER),
+        churn_thr=np.uint32(threshold32(cfg.churn_rate)
+                            if cfg.churn_rate > 0 else 0),
+        churn_lo=np.int32(cfg.total_ticks // 4),
+        churn_span=np.int32(max(cfg.total_ticks // 2, 1)),
+        churn_after=np.int32(cfg.rejoin_after
+                             if cfg.rejoin_after is not None else 40),
+        drop_on=np.bool_(bool(cfg.drop_msg)),
+        drop_open=np.int32(cfg.drop_open_tick),
+        drop_close=np.int32(cfg.drop_close_tick),
+        drop_thr=np.uint32(threshold32(cfg.msg_drop_prob)),
+        deg_thr=np.asarray(degree_thresholds(cfg, resolved_dims(cfg)[1])),
     )
 
 

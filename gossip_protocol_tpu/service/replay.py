@@ -125,11 +125,12 @@ def run_sequential(trace) -> tuple[list, float]:
 
 
 def run_service(trace, max_batch: int = 8,
-                service: FleetService | None = None
+                service: FleetService | None = None,
+                pipeline: bool | None = None
                 ) -> tuple[list, FleetService, float]:
     """The serving leg: submit the stream, drain, collect results."""
     svc = service if service is not None else FleetService(
-        max_batch=max_batch)
+        max_batch=max_batch, pipeline=pipeline)
     t0 = time.perf_counter()
     handles = [svc.submit(tpl.cfg, seed=seed, mode=tpl.mode)
                for tpl, seed in trace]
@@ -192,7 +193,8 @@ def node_ticks(trace) -> int:
 
 def replay(templates: list[Template], seeds_per_template: int,
            max_batch: int = 8, check_parity: bool = True,
-           mesh=None, sequential=None, return_legs: bool = False):
+           mesh=None, sequential=None, return_legs: bool = False,
+           pipeline: bool | None = None):
     """Full A/B replay; returns the service-metrics dict for BENCH.
 
     Raises on any per-request parity mismatch — a serving layer that
@@ -213,7 +215,8 @@ def replay(templates: list[Template], seeds_per_template: int,
     against it.
     """
     trace = build_trace(templates, seeds_per_template)
-    svc = FleetService(max_batch=max_batch, mesh=mesh)
+    svc = FleetService(max_batch=max_batch, mesh=mesh,
+                       pipeline=pipeline)
     warm(trace, svc)
     if sequential is None:
         seq_results, seq_wall = run_sequential(trace)
@@ -262,7 +265,10 @@ def replay(templates: list[Template], seeds_per_template: int,
         "latency_p50_s": stats["latency_p50_s"],
         "latency_p95_s": stats["latency_p95_s"],
         "mean_occupancy": stats["mean_occupancy"],
+        "pipeline": stats["pipeline"],
+        "mean_pack_s": stats["mean_pack_s"],
         "mean_device_wait_s": stats["mean_device_wait_s"],
+        "mean_fetch_s": stats["mean_fetch_s"],
         "mean_host_s": stats["mean_host_s"],
         "device_wait_frac": stats["device_wait_frac"],
         # compiled-program reuse per dispatch (zero new builds) — the
@@ -284,7 +290,8 @@ def chaos_replay(templates: list[Template], seeds_per_template: int,
                  max_batch: int = 8, mesh=None, fault_seed: int = 0,
                  fault_rate: float = 0.12, device_loss_at="mid",
                  max_retries: int = 4, backoff_base_s: float = 0.01,
-                 sequential=None, return_legs: bool = False):
+                 sequential=None, return_legs: bool = False,
+                 pipeline: bool | None = None):
     """The chaos acceptance harness: the mixed replay under a SEEDED
     fault schedule (service/faults.py) plus one mid-replay device
     loss, with the gate enforced in-line:
@@ -333,8 +340,13 @@ def chaos_replay(templates: list[Template], seeds_per_template: int,
         # a bucket the fault schedule manages to open stays
         # deterministically quarantined (its requests degrade to solo,
         # which still completes and parity-checks) instead of
-        # half-open-probing on real elapsed wall time
-        breaker=BreakerPolicy(reset_after_s=float("inf")))
+        # half-open-probing on real elapsed wall time.  Pipelining
+        # (the default) keeps determinism: launches, resolves, and
+        # retries all happen at fixed points of the submit/flush
+        # sequence, so attempt indices — and with them the fault
+        # schedule — are still a pure function of submit order.
+        breaker=BreakerPolicy(reset_after_s=float("inf")),
+        pipeline=pipeline)
     warm(trace, svc)
     if sequential is None:
         seq_results, seq_wall = run_sequential(trace)
@@ -402,6 +414,7 @@ def chaos_replay(templates: list[Template], seeds_per_template: int,
         "latency_p95_s": stats["latency_p95_s"],
         "mean_occupancy": stats["mean_occupancy"],
         "dispatches": stats["dispatches"],
+        "pipeline": stats["pipeline"],
         "breaker_open_buckets": stats["breaker_open_buckets"],
     }
     if return_legs:

@@ -96,6 +96,10 @@ class RequestHandle:
     _result: Optional[object] = field(default=None, repr=False)
     _metrics: Optional[RequestMetrics] = field(default=None, repr=False)
     _error: Optional[BaseException] = field(default=None, repr=False)
+    #: set by the pipelined scheduler when the request's batch has been
+    #: LAUNCHED on device but not yet resolved (cleared if the batch is
+    #: re-queued by an interrupted dispatch)
+    _launched: bool = field(default=False, repr=False)
 
     @property
     def done(self) -> bool:
@@ -108,12 +112,15 @@ class RequestHandle:
 
     @property
     def status(self) -> str:
-        """``pending`` | ``completed`` | ``degraded`` | ``failed``."""
+        """``pending`` | ``in_flight`` | ``completed`` | ``degraded``
+        | ``failed``.  ``in_flight`` (pipelined scheduling, PR 6)
+        means the batch's device program is launched and executing;
+        ``result()``/``flush()`` resolves it."""
         if self._error is not None:
             return "failed"
         if self._metrics is not None:
             return "degraded" if self._metrics.degraded else "completed"
-        return "pending"
+        return "in_flight" if self._launched else "pending"
 
     def exception(self) -> Optional[BaseException]:
         """The terminal error (None unless :attr:`failed`)."""

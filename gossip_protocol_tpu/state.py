@@ -104,8 +104,16 @@ class Schedule:
 NEVER = np.iinfo(np.int32).max  # sentinel fail_tick for peers that never fail
 
 
-def make_schedule(cfg: SimConfig) -> Schedule:
-    """Build the injection schedule for a scenario.
+def make_schedule_host(cfg: SimConfig) -> Schedule:
+    """:func:`make_schedule` with pure NUMPY leaves — zero eager
+    device ops.  The fleet serving path stages lane schedules with
+    this (core/fleet.py): on the pipelined dispatch path a fleet
+    program is often in flight, and eager jnp staging either blocks
+    at the client's bounded in-flight queue or costs device
+    round-trips per lane; host leaves enter device code as ordinary
+    jit-call inputs.  NOT for code that closes over the schedule
+    inside a traced function (a numpy ``drop_active`` indexed by a
+    traced tick raises) — that is what :func:`make_schedule` is for.
 
     Mirrors ``Application::fail`` semantics exactly:
 
@@ -147,12 +155,30 @@ def make_schedule(cfg: SimConfig) -> Schedule:
     if cfg.drop_msg:
         drop = (t > cfg.drop_open_tick) & (t <= cfg.drop_close_tick)
     return Schedule(
-        start_tick=jnp.asarray(start),
-        fail_tick=jnp.asarray(fail),
-        rejoin_tick=jnp.asarray(rejoin),
-        drop_active=jnp.asarray(drop),
+        start_tick=start,
+        fail_tick=fail,
+        rejoin_tick=rejoin,
+        drop_active=drop,
+        drop_prob=np.float32(cfg.msg_drop_prob),
+    )
+
+
+def make_schedule(cfg: SimConfig) -> Schedule:
+    """Build the injection schedule for a scenario (device leaves).
+
+    See :func:`make_schedule_host` for the numpy-leaf variant; this
+    one wraps the leaves in jnp arrays so consumers that CLOSE OVER
+    the schedule inside traced code keep working.
+    """
+    s = make_schedule_host(cfg)
+    return Schedule(
+        start_tick=jnp.asarray(s.start_tick),
+        fail_tick=jnp.asarray(s.fail_tick),
+        rejoin_tick=jnp.asarray(s.rejoin_tick),
+        drop_active=jnp.asarray(s.drop_active),
         drop_prob=jnp.float32(cfg.msg_drop_prob),
     )
+
 
 
 def init_state(cfg: SimConfig) -> WorldState:

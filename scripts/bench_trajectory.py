@@ -1,0 +1,147 @@
+"""Bench trajectory: one regression table over every BENCH_pr*.json.
+
+The per-PR bench jsons each hold a snapshot; reading the series means
+opening five+ files and hunting for the comparable keys.  This script
+folds them into one table — headline node-ticks/s, fleet batching
+speedup, serving replay speedup (best recorded: mixed / mesh / the
+204-request curve's top row), p95 latency, device-wait fraction, and
+the chaos gate — so a regression (or a claimed win) is visible at a
+glance, PR over PR.
+
+    PYTHONPATH=. python scripts/bench_trajectory.py          # table
+    PYTHONPATH=. python scripts/bench_trajectory.py --json   # rows
+
+Pure host-side JSON reading: no jax import, safe on any machine.
+"""
+
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _get(d: dict, *path, default=None):
+    for p in path:
+        if not isinstance(d, dict) or p not in d:
+            return default
+        d = d[p]
+    return d
+
+
+def _best_replay(sec: dict):
+    """Best recorded serving-replay row in one json: (speedup, p95,
+    device_wait_frac, requests, tag)."""
+    best = None
+    for tag in ("service_replay_mixed", "service_replay_mixed_mesh",
+                "service_replay_pipeline_204req"):
+        e = sec.get(tag)
+        if not isinstance(e, dict):
+            continue
+        rows = [e]
+        # D-curve entries nest rows under d1/d2/... (and the PR-6
+        # pipeline sweep nests sync/pipelined one level below that)
+        for k, v in e.items():
+            if re.fullmatch(r"d\d+", k) and isinstance(v, dict):
+                rows.append(v)
+                rows += [w for w in v.values() if isinstance(w, dict)]
+        for r in rows:
+            sp = r.get("speedup_vs_sequential")
+            if sp is None:
+                continue
+            row = (sp, r.get("latency_p95_s"),
+                   r.get("device_wait_frac"), r.get("requests"), tag)
+            if best is None or sp > best[0]:
+                best = row
+    for tag in ("service_replay_mesh_curve_204req",):
+        e = sec.get(tag)
+        if isinstance(e, dict):
+            for k, r in e.items():
+                if re.fullmatch(r"d\d+", k) and isinstance(r, dict):
+                    sp = r.get("speedup_vs_sequential")
+                    if sp is not None and (best is None or sp > best[0]):
+                        best = (sp, r.get("latency_p95_s"),
+                                r.get("device_wait_frac"), 204, tag)
+    return best
+
+
+def load_rows():
+    rows = []
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_pr*.json"))):
+        pr = re.search(r"BENCH_pr(\d+)", path).group(1)
+        try:
+            d = json.load(open(path))
+        except (OSError, json.JSONDecodeError) as e:
+            rows.append({"pr": pr, "error": str(e)})
+            continue
+        sec = d.get("secondary", {})
+        fleet = None
+        for k, v in sec.items():
+            if k.startswith("fleet") and isinstance(v, dict):
+                fleet = v.get("speedup_vs_sequential")
+                break
+        replay = _best_replay(sec)
+        chaos = (_get(sec, "service_replay_chaos_204req")
+                 or _get(sec, "service_replay_chaos") or {})
+        rows.append({
+            "pr": pr,
+            "backend": d.get("backend"),
+            "devices": _get(d, "env", "device_count"),
+            "headline_metric": d.get("metric"),
+            "headline_node_ticks_per_s": d.get("value"),
+            "fleet_speedup": fleet,
+            "replay_speedup": replay[0] if replay else None,
+            "replay_p95_s": replay[1] if replay else None,
+            "replay_device_wait_frac": replay[2] if replay else None,
+            "replay_source": replay[4] if replay else None,
+            "chaos_completion": chaos.get("completion_rate"),
+            "chaos_speedup": chaos.get("speedup_vs_sequential"),
+        })
+    return rows
+
+
+def _fmt(v, spec="{:.2f}"):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return spec.format(v)
+    return str(v)
+
+
+def main(argv) -> int:
+    rows = load_rows()
+    if not rows:
+        print("no BENCH_pr*.json found", file=sys.stderr)
+        return 1
+    if "--json" in argv:
+        print(json.dumps(rows, indent=1))
+        return 0
+    cols = [("PR", "pr", "{}"), ("backend", "backend", "{}"),
+            ("dev", "devices", "{}"),
+            ("headline nt/s", "headline_node_ticks_per_s", "{:,.0f}"),
+            ("fleet x", "fleet_speedup", "{:.2f}"),
+            ("replay x", "replay_speedup", "{:.2f}"),
+            ("p95 s", "replay_p95_s", "{:.2f}"),
+            ("dev-frac", "replay_device_wait_frac", "{:.2f}"),
+            ("chaos", "chaos_completion", "{:.0%}")]
+    table = [[_fmt(r.get(key), spec) for _, key, spec in cols]
+             for r in rows]
+    widths = [max(len(h), *(len(t[i]) for t in table))
+              for i, (h, _, _) in enumerate(cols)]
+    print("  ".join(h.rjust(w) for (h, _, _), w in zip(cols, widths)))
+    for t in table:
+        print("  ".join(c.rjust(w) for c, w in zip(t, widths)))
+    # delta line: latest vs previous headline
+    vals = [r.get("headline_node_ticks_per_s") for r in rows
+            if r.get("headline_node_ticks_per_s")]
+    if len(vals) >= 2:
+        print(f"\nheadline: {vals[-1]:,.0f} nt/s "
+              f"({(vals[-1] / vals[-2] - 1) * 100:+.1f}% vs prev PR, "
+              f"{(vals[-1] / vals[0] - 1) * 100:+.1f}% vs PR {rows[0]['pr']})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

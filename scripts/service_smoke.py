@@ -10,6 +10,17 @@ Modes:
     python scripts/service_smoke.py sweep             # max_batch sweep
     python scripts/service_smoke.py mesh [34]         # replay per device count
     python scripts/service_smoke.py chaos [34] [0.12] # seeded fault sweep
+    python scripts/service_smoke.py pipeline [34]     # pipelined vs sync per D
+
+``pipeline`` (PR 6) replays the acceptance stream at each D in
+{1, 2, 4, 8} TWICE — pipelined dispatch (the default) vs the
+synchronous beat — after one small untimed warm lap per D, sharing
+one sequential baseline, and prints both rows with the
+pack/execute/fetch decomposition.  The acceptance gate reads
+device-wait frac >= 0.8 from the SYNC row (un-overlapped timing is
+the clean serialized measurement) and speedup > the PR-4 5.62x from
+the PIPELINED row (the shipped default's wall) — docs/PERF.md §11
+has the analysis.
 
 ``mesh`` re-runs the acceptance replay served from a lane mesh
 (parallel/fleet_mesh.py) at each D in {1, 2, 4, 8} with EQUAL total
@@ -48,7 +59,7 @@ import json
 import os
 import sys
 
-if sys.argv[1:2] and sys.argv[1] in ("mesh", "chaos"):
+if sys.argv[1:2] and sys.argv[1] in ("mesh", "chaos", "pipeline"):
     # virtual devices must be forced before jax is first imported
     os.environ["JAX_PLATFORMS"] = "cpu"
     _flags = os.environ.get("XLA_FLAGS", "")
@@ -117,6 +128,60 @@ def main(argv) -> int:
                   f"device-wait frac {m['device_wait_frac']:.2f}",
                   flush=True)
         return 0
+    elif mode == "pipeline":
+        from gossip_protocol_tpu.parallel.fleet_mesh import make_lane_mesh
+        seeds = int(argv[1]) if len(argv) > 1 else 34
+        tpls = _templates(512, 96)
+        seq = None
+        rows = {}
+        for d in (1, 2, 4, 8):
+            if d > jax.device_count():
+                print(f"D={d}: skipped (only {jax.device_count()} "
+                      "devices live)", flush=True)
+                continue
+            mesh = None if d == 1 else make_lane_mesh(d)
+            # one untimed full-size serving lap per device count first
+            # (service leg only — no sequential baseline, no parity):
+            # the first lap at a new D pays decaying per-dispatch
+            # trace/placement-cache costs that are not steady-state
+            # serving behavior — both timed rows below measure warm laps
+            from gossip_protocol_tpu.service import FleetService
+            from gossip_protocol_tpu.service.replay import (build_trace,
+                                                            run_service)
+            from gossip_protocol_tpu.service.replay import warm as _warm
+            trace_w = build_trace(tpls, seeds)
+            svc_w = FleetService(max_batch=8 // d, mesh=mesh)
+            _warm(trace_w, svc_w)
+            run_service(trace_w, service=svc_w)
+            for pipe in (False, True):
+                kw = dict(max_batch=8 // d, mesh=mesh, pipeline=pipe)
+                if seq is None:
+                    m, seq = replay(tpls, seeds, return_legs=True, **kw)
+                else:
+                    m = replay(tpls, seeds, sequential=seq, **kw)
+                rows[(d, pipe)] = m
+                tag = "pipelined" if pipe else "sync     "
+                print(f"D={d} {tag}: {m['speedup_vs_sequential']:5.2f}x "
+                      f"sequential, device-wait frac "
+                      f"{m['device_wait_frac']:.2f} "
+                      f"(pack {1e3 * m['mean_pack_s']:5.1f}ms / exec "
+                      f"{1e3 * m['mean_device_wait_s']:6.1f}ms / fetch "
+                      f"{1e3 * m['mean_fetch_s']:5.1f}ms), "
+                      f"p95 {m['latency_p95_s']:.2f}s", flush=True)
+        d_max = max(d for d, _ in rows)
+        # frac gate reads the SYNC row (un-overlapped timing is the
+        # clean serialized measurement; the pipelined row measures its
+        # hidden host columns at contended values), speedup gate reads
+        # the pipelined row (the shipped default's wall)
+        frac = rows[(d_max, False)]["device_wait_frac"]
+        speedup = rows[(d_max, True)]["speedup_vs_sequential"]
+        ok = frac >= 0.8 and speedup > 5.62
+        print(f"acceptance (D={d_max}): device-wait frac {frac:.2f} "
+              f"{'OK' if frac >= 0.8 else 'FAIL'} (>=0.8, sync row), "
+              f"pipelined speedup {speedup:.2f}x "
+              f"{'OK' if speedup > 5.62 else 'FAIL'} (>5.62x), "
+              f"parity OK (enforced)", flush=True)
+        return 0 if ok else 1
     elif mode == "chaos":
         from gossip_protocol_tpu.parallel.fleet_mesh import make_lane_mesh
         seeds = int(argv[1]) if len(argv) > 1 else 34

@@ -225,3 +225,61 @@ def test_run_bench_no_rebuild():
     # a second Simulation of the same shape shares the process cache
     Simulation(cfg).run_bench(seed=4)
     assert run_build_count() == built
+
+
+# ---- launch/resolve split (PR 6) -------------------------------------
+def test_launch_defer_start_resolve_parity():
+    """The pipelined engine protocol — launch(defer=True) stages
+    without dispatching, start() dispatches, resolve() fetches — and
+    the result is bit-identical to run(), with the wall decomposed as
+    pack + execute + fetch."""
+    cfg = _overlay_churn()
+    sim = FleetSimulation(cfg)
+    ref = sim.run(seeds=[7, 8])
+    pending = sim.launch(seeds=[7, 8], warmup=False, defer=True)
+    pending.start()
+    res = pending.resolve()
+    assert pending.resolve() is res              # idempotent
+    for i in range(2):
+        _assert_state_equal(ref.lanes[i].final_state,
+                            res.lanes[i].final_state,
+                            OV_STATE_FIELDS, f"lane {i}")
+        for f in OV_METRIC_FIELDS:
+            assert np.array_equal(np.asarray(getattr(ref.lanes[i].metrics, f)),
+                                  np.asarray(getattr(res.lanes[i].metrics, f)))
+    assert res.pack_seconds >= 0.0 and res.fetch_seconds >= 0.0
+    assert res.device_seconds > 0.0
+    assert res.wall_seconds == pytest.approx(
+        res.pack_seconds + res.device_seconds + res.fetch_seconds,
+        rel=1e-6)
+
+
+def test_stack_lanes_variants_agree():
+    """The three lane-stacking paths (eager jnp, one jitted program,
+    host numpy) produce identical stacked trees — the launch paths
+    mix them by leaf origin, so they must never drift."""
+    from gossip_protocol_tpu.models.overlay import make_overlay_schedule
+    from gossip_protocol_tpu.core.fleet import (stack_lanes_host,
+                                                stack_lanes_jit)
+    scheds = [make_overlay_schedule(_overlay_churn().replace(seed=s))
+              for s in (1, 2, 3)]
+    eager = stack_lanes(scheds)
+    jitted = stack_lanes_jit(scheds)
+    host = stack_lanes_host(scheds)
+    import jax
+    for a, b, c in zip(jax.tree.leaves(eager), jax.tree.leaves(jitted),
+                       jax.tree.leaves(host)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+        assert np.array_equal(np.asarray(a), np.asarray(c))
+        assert np.asarray(a).dtype == np.asarray(c).dtype
+
+
+def test_launch_resolve_without_explicit_start():
+    """resolve() on a deferred launch auto-starts (a sync fallback
+    path must never deadlock on a never-dispatched program)."""
+    cfg = _dense_drop(n=16, ticks=30)
+    sim = FleetSimulation(cfg)
+    ref = Simulation(cfg).run_bench(seed=5)
+    res = sim.launch_bench(seeds=[5, 6], warmup=False,
+                           defer=True).resolve()
+    assert np.array_equal(ref.sent, res.lanes[0].sent)

@@ -110,6 +110,7 @@ def test_transient_fault_recovered_with_parity():
                            injector=FaultInjector(schedule={1: kind}),
                            retry=_fast_retry())
         hs = [svc.submit(cfg, seed=s) for s in (1, 2)]
+        svc.drain()     # resolve-side faults (poison) surface here
         assert [h.status for h in hs] == ["completed", "completed"], kind
         assert all(h.metrics.retries == 1 for h in hs), kind
         assert np.array_equal(hs[0].result().sent, ref.sent), kind
@@ -131,6 +132,7 @@ def test_poison_overlay_lane_detected():
                        injector=FaultInjector(schedule={1: "poison"}),
                        retry=_fast_retry())
     hs = [svc.submit(cfg, seed=s) for s in (1, 2)]
+    svc.drain()         # poison is applied (and caught) at resolve
     assert [h.status for h in hs] == ["completed", "completed"]
     st = svc.stats()["failures"]
     assert st["poisoned_lanes"] == 1 and st["retries"] == 1
@@ -156,14 +158,14 @@ def test_clean_replay_raises_on_hidden_degradation(monkeypatch):
     from gossip_protocol_tpu.core.fleet import FleetSimulation
     from gossip_protocol_tpu.service import replay
 
-    real_run = FleetSimulation.run
+    real_launch = FleetSimulation.launch
 
-    def broken_run(self, *a, **kw):
+    def broken_launch(self, *a, **kw):
         if kw.get("n_real") == 1:      # keep the warm pass alive
-            return real_run(self, *a, **kw)
+            return real_launch(self, *a, **kw)
         raise RuntimeError("engine regression")
 
-    monkeypatch.setattr(FleetSimulation, "run", broken_run)
+    monkeypatch.setattr(FleetSimulation, "launch", broken_launch)
     with pytest.raises(RuntimeError,
                        match="degraded|dispatch path is broken"):
         replay(overlay_templates(n=128, ticks=48), seeds_per_template=2,
@@ -177,6 +179,7 @@ def test_injected_latency_counts_without_failing():
                                               latency_s=1e-3),
                        retry=_fast_retry())
     hs = [svc.submit(cfg, seed=s) for s in (1, 2)]
+    svc.drain()         # the latency stall happens at resolve
     assert all(h.status == "completed" and h.metrics.retries == 0
                for h in hs)
     assert svc.stats()["failures"]["injected_latency_s"] > 0.0
@@ -238,9 +241,11 @@ def test_deadline_missed_accounting_on_late_completion():
     clock = _Clock()
     svc = FleetService(max_batch=1, clock=clock, sleep=clock.sleep,
                        default_deadline_s=5.0)
-    # max_batch=1: the submit itself dispatches, completing at the
-    # fake clock's frozen "now" == submit time -> not missed
+    # max_batch=1: the submit itself dispatches (pipelined: launches);
+    # the flush resolves it at the fake clock's frozen "now" == submit
+    # time -> not missed
     h = svc.submit(cfg, seed=1)
+    svc.drain()
     assert h.status == "completed" and not h.metrics.deadline_missed
 
 
@@ -304,6 +309,7 @@ def test_breaker_opens_quarantines_and_recovers():
     # after the cooldown: one probe dispatch, success closes it
     clock.t += 11.0
     h4 = [svc.submit(cfg, seed=s) for s in (1, 7)]
+    svc.drain()          # the pipelined probe resolves here
     assert all(h.status == "completed" for h in h4)
     assert svc.stats()["breaker_open_buckets"] == 0
     assert np.array_equal(h4[0].result().sent, ref.sent)
@@ -340,15 +346,23 @@ def test_unstack_miscount_is_caught_not_mispaired():
     svc = FleetService(max_batch=2, retry=_fast_retry(max_retries=0))
     key = bucket_key(cfg, "trace")
     fleet_sim = svc.cache.get(key, cfg)
-    real_run = fleet_sim.run
+    real_launch = fleet_sim.launch
 
-    def leaky_run(*a, **kw):
-        fleet = real_run(*a, **kw)
-        fleet.lanes.append(fleet.lanes[-1])      # a filler lane "leaks"
-        return fleet
+    def leaky_launch(*a, **kw):
+        pending = real_launch(*a, **kw)
+        real_resolve = pending.resolve
 
-    fleet_sim.run = leaky_run
+        def leaky_resolve():
+            fleet = real_resolve()
+            fleet.lanes.append(fleet.lanes[-1])  # a filler lane "leaks"
+            return fleet
+
+        pending.resolve = leaky_resolve
+        return pending
+
+    fleet_sim.launch = leaky_launch
     hs = [svc.submit(cfg, seed=s) for s in (1, 2)]
+    svc.drain()          # the miscount is detected at resolve
     # the leak is detected, the batch degrades to solo -> right results
     assert [h.status for h in hs] == ["degraded", "degraded"]
     assert np.array_equal(hs[0].result().sent, ref.sent)
@@ -360,6 +374,106 @@ def test_fleet_unstack_invariant_direct():
     _check_unstacked([1, 2, 3], 3)
     with pytest.raises(RuntimeError, match="never be unstacked"):
         _check_unstacked([1, 2, 3, 4], 3)
+
+
+def test_pending_fleet_failed_resolution_reraises():
+    """A FAILED resolution must re-raise on every later resolve()
+    call (the step is retained), never silently return None."""
+    from gossip_protocol_tpu.core.fleet import PendingFleet
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise RuntimeError("boom")
+
+    p = PendingFleet(bad, 0.0)
+    with pytest.raises(RuntimeError, match="boom"):
+        p.resolve()
+    with pytest.raises(RuntimeError, match="boom"):
+        p.resolve()
+    assert len(calls) == 2
+
+
+def test_interrupted_pipelined_dispatch_requeues_exactly_once():
+    """A non-Exception escape (KeyboardInterrupt) out of a pipelined
+    dispatch re-queues the popped requests EXACTLY once — the inner
+    handlers and _dispatch's deduped backstop must not stack
+    duplicate queue entries — and the next flush serves them."""
+    from gossip_protocol_tpu.service import bucket_key
+    cfg = _dense_churn()
+    ref = Simulation(cfg).run(seed=1)
+    svc = FleetService(max_batch=2, pipeline=True)
+    key = bucket_key(cfg, "trace")
+    sim = svc.cache.get(key, cfg)
+    real_launch = sim.launch
+    boom = {"armed": True}
+
+    def interrupted_launch(*a, **kw):
+        if boom.pop("armed", False):
+            raise KeyboardInterrupt
+        return real_launch(*a, **kw)
+
+    sim.launch = interrupted_launch
+    h1 = svc.submit(cfg, seed=1)
+    with pytest.raises(KeyboardInterrupt):
+        svc.submit(cfg, seed=2)
+    q = svc._queues[key]
+    assert len(q) == 2 and len({r.rid for r in q}) == 2, \
+        "requests re-queued more than once (or lost)"
+    assert h1.status == "pending"
+    svc.drain()
+    assert h1.status == "completed"
+    assert np.array_equal(h1.result().sent, ref.sent)
+    assert not svc._handles
+
+
+# ---- resilience under overlap (PR 6) ---------------------------------
+def test_fault_in_batch_k_does_not_corrupt_staged_k_plus_1():
+    """A poison fault detected while resolving batch k — AFTER batch
+    k+1 (a different bucket) has already been staged and dispatched —
+    must retry k in place without touching k+1: both buckets complete
+    with bit-parity, only k pays retries."""
+    cfg_a = _dense_churn(n=16, ticks=22)
+    cfg_b = _dense_churn(n=12, ticks=26)
+    ref_a = Simulation(cfg_a).run(seed=1)
+    ref_b = Simulation(cfg_b).run(seed=3)
+    svc = FleetService(max_batch=2, pipeline=True,
+                       injector=FaultInjector(schedule={1: "poison"}),
+                       retry=_fast_retry())
+    ha = [svc.submit(cfg_a, seed=s) for s in (1, 2)]   # batch k
+    assert svc.in_flight == 2
+    hb = [svc.submit(cfg_b, seed=s) for s in (3, 4)]   # batch k+1:
+    # staging k+1 resolved k, caught the poison, and retried k while
+    # k+1 executes — k terminal, k+1 in flight
+    assert [h.status for h in ha] == ["completed", "completed"]
+    assert all(h.metrics.retries == 1 for h in ha)
+    assert svc.in_flight == 2
+    svc.drain()
+    assert [h.status for h in hb] == ["completed", "completed"]
+    assert all(h.metrics.retries == 0 for h in hb)
+    assert np.array_equal(ha[0].result().sent, ref_a.sent)
+    assert np.array_equal(hb[0].result().sent, ref_b.sent)
+    st = svc.stats()["failures"]
+    assert st["poisoned_lanes"] == 1 and st["retries"] == 1
+    assert not svc._handles
+
+
+def test_chaos_replay_digest_stable_with_pipelining():
+    """chaos_replay stays seed-replayable digest-for-digest with
+    pipelining forced ON: launches, resolves, and retries all happen
+    at fixed points of the submit/flush sequence, so the fault
+    schedule and per-request outcomes are a pure function of submit
+    order."""
+    tpls = overlay_templates(n=128, ticks=48)
+    kw = dict(seeds_per_template=3, max_batch=4, fault_seed=11,
+              fault_rate=0.3, device_loss_at=None, pipeline=True)
+    m1, seq = chaos_replay(tpls, return_legs=True, **kw)
+    m2 = chaos_replay(tpls, sequential=seq, **kw)
+    assert m1["pipeline"] is True
+    assert m1["faults"]["total"] > 0
+    assert m1["schedule_digest"] == m2["schedule_digest"]
+    assert m1["outcome_digest"] == m2["outcome_digest"]
+    assert m1["completion_rate"] == m2["completion_rate"] == 1.0
 
 
 # ---- mesh degradation ------------------------------------------------

@@ -166,10 +166,16 @@ def test_warmed_bucket_never_builds_on_dispatch():
 # ---- flush policies --------------------------------------------------
 def test_flush_on_max_batch():
     cfg = _dense_churn(n=16, ticks=22)
-    svc = FleetService(max_batch=4)
+    svc = FleetService(max_batch=4, pipeline=True)
     handles = [svc.submit(cfg, seed=s) for s in range(4)]
-    # the 4th submit fills the bucket: dispatched inside submit()
+    # the 4th submit fills the bucket: LAUNCHED inside submit(); under
+    # pipelined dispatch (PR 6) the batch rides in flight — its device
+    # program executing — until the next launch or a flush resolves it
     assert svc.pending == 0
+    assert svc.in_flight == 4
+    assert all(h.status == "in_flight" for h in handles)
+    svc.drain()
+    assert svc.in_flight == 0
     assert all(h.done for h in handles)
     assert handles[0].metrics.occupancy == 1.0
 
@@ -177,7 +183,8 @@ def test_flush_on_max_batch():
 def test_flush_on_max_wait():
     cfg = _dense_churn(n=16, ticks=22)
     clock = _Clock()
-    svc = FleetService(max_batch=8, max_wait_s=5.0, clock=clock)
+    svc = FleetService(max_batch=8, max_wait_s=5.0, clock=clock,
+                       pipeline=True)
     h = svc.submit(cfg, seed=1)
     assert not h.done and svc.pending == 1
     clock.t = 3.0
@@ -185,6 +192,8 @@ def test_flush_on_max_wait():
     assert not h.done, "flushed before max_wait elapsed"
     clock.t = 6.0
     assert svc.pump() == 1
+    assert h.status == "in_flight"    # launched by the max-wait flush
+    svc.flush()
     assert h.done
     assert h.metrics.batch == 1 and h.metrics.padded_batch == 8
 
@@ -296,6 +305,7 @@ def test_failed_dispatch_is_atomic_regression():
     bad = [svc.submit(cfg, seed=s) for s in (3, 4)]
     assert all(h.status == "failed" for h in bad)
     good = [svc.submit(cfg, seed=s) for s in (5, 6)]
+    svc.drain()                 # resolve the pipelined clean batch
     assert all(h.status == "completed" for h in good)
     ref = Simulation(cfg).run(seed=5)
     assert np.array_equal(good[0].result().sent, ref.sent)
@@ -451,16 +461,107 @@ def test_lru_eviction_spares_sibling_bucket_programs():
 
 def test_stats_device_host_split():
     """Satellite: stats() splits the per-dispatch wall into
-    device-wait vs host stack/unstack time."""
+    pack / execute (device wait) / fetch, with host = pack + fetch —
+    so the pipelined numbers decompose honestly instead of burying
+    the blocking result fetch inside device wait."""
     cfg = _dense_churn(n=16, ticks=22)
     svc = FleetService(max_batch=2)
     [svc.submit(cfg, seed=s) for s in (1, 2)]
     svc.drain()
     st = svc.stats()
     assert st["mean_device_wait_s"] > 0.0
-    assert st["mean_host_s"] >= 0.0
+    assert st["mean_pack_s"] >= 0.0 and st["mean_fetch_s"] >= 0.0
+    assert st["mean_host_s"] == pytest.approx(
+        st["mean_pack_s"] + st["mean_fetch_s"], abs=1e-6)
     assert 0.0 < st["device_wait_frac"] <= 1.0
     assert st["devices"] == 1 and st["capacity"] == 2
+    for d in svc._dispatches:
+        assert d["host_s"] == pytest.approx(d["pack_s"] + d["fetch_s"])
+        assert d["wall_s"] == pytest.approx(
+            d["pack_s"] + d["device_wait_s"] + d["fetch_s"], rel=1e-6)
+
+
+# ---- pipelined dispatch (PR 6 tentpole) ------------------------------
+def test_pipelined_replay_parity_and_stats():
+    """A mixed replay with pipelining forced ON: per-request
+    bit-parity is enforced inside replay(), nothing is left in
+    flight, and the metrics carry the pipeline flag + decomposition."""
+    from gossip_protocol_tpu.service import (grader_templates,
+                                             overlay_templates, replay)
+    m = replay(grader_templates() + overlay_templates(n=128, ticks=48),
+               seeds_per_template=3, max_batch=4, pipeline=True)
+    assert m["pipeline"] is True
+    assert m["parity_checked"]
+    assert m["max_builds_per_bucket"] <= 1
+    assert m["mean_pack_s"] >= 0.0 and m["mean_fetch_s"] >= 0.0
+    assert m["device_wait_frac"] > 0.0
+
+
+def test_pipeline_modes_serve_identical_results():
+    """The same stream served pipelined and synchronous returns
+    bit-identical lanes (the overlap must be invisible to results)."""
+    cfg = _dense_churn(n=16, ticks=22)
+    lanes = {}
+    for pipe in (True, False):
+        svc = FleetService(max_batch=2, pipeline=pipe)
+        hs = [svc.submit(cfg, seed=s) for s in (1, 2, 3)]
+        svc.drain()
+        assert all(h.status == "completed" for h in hs)
+        lanes[pipe] = [h.result() for h in hs]
+    for a, b in zip(lanes[True], lanes[False]):
+        assert np.array_equal(a.sent, b.sent)
+        assert np.array_equal(a.recv, b.recv)
+        assert np.array_equal(np.asarray(a.final_state.known),
+                              np.asarray(b.final_state.known))
+
+
+def test_multichunk_trace_falls_back_to_sync_beat():
+    """A launch the engine cannot defer (multi-chunk dense trace
+    executes eagerly inside launch()) must be served on the
+    synchronous beat — previous batch resolved first, this batch
+    completed before the dispatch returns, never left pretending to
+    be in flight."""
+    cfg = _dense_churn(n=16, ticks=22)
+    svc = FleetService(max_batch=2, pipeline=True, chunk_ticks=8)
+    hs = [svc.submit(cfg, seed=s) for s in (1, 2)]
+    assert all(h.status == "completed" for h in hs)
+    assert svc.in_flight == 0
+    ref = Simulation(cfg).run(seed=1)
+    assert np.array_equal(hs[0].result().sent, ref.sent)
+
+
+def test_pump_harvests_finished_inflight():
+    """A poll-driven caller must see completions without forcing a
+    flush: a pump that makes no dispatch harvests the in-flight batch
+    once its program is ready (non-blocking readiness check)."""
+    import time as _time
+    cfg = _dense_churn(n=16, ticks=22)
+    svc = FleetService(max_batch=2, pipeline=True)
+    hs = [svc.submit(cfg, seed=s) for s in (1, 2)]
+    assert svc.in_flight == 2
+    for _ in range(500):
+        if all(h.done for h in hs):
+            break
+        svc.pump()
+        _time.sleep(0.01)
+    assert all(h.status == "completed" for h in hs)
+    assert svc.in_flight == 0
+
+
+def test_inflight_resolves_via_result_and_stats_nonblocking():
+    """result() on an in-flight handle resolves it (flush of its
+    bucket); stats() must NOT resolve anything (non-blocking metric
+    capture)."""
+    cfg = _dense_churn(n=16, ticks=22)
+    svc = FleetService(max_batch=2, pipeline=True)
+    hs = [svc.submit(cfg, seed=s) for s in (1, 2)]
+    assert svc.in_flight == 2
+    st = svc.stats()                      # must not resolve
+    assert st["in_flight"] == 2 and st["pipeline"] is True
+    assert svc.in_flight == 2
+    ref = Simulation(cfg).run(seed=1)
+    assert np.array_equal(hs[0].result().sent, ref.sent)
+    assert svc.in_flight == 0 and hs[1].done
 
 
 @pytest.mark.slow
