@@ -434,6 +434,19 @@ def bench_dense(n: int, ticks: int):
     return cfg, best.node_ticks_per_second
 
 
+def _env_provenance() -> dict:
+    """The env stamp every serving BENCH entry carries (mesh and load
+    numbers are meaningless without the live device count and the XLA
+    flags that forced it) — one definition, so the entries cannot
+    drift apart in schema."""
+    import jax
+    return {
+        "device_count": jax.device_count(),
+        "jax_backend": jax.default_backend(),
+        "xla_flags": os.environ.get("XLA_FLAGS", ""),
+    }
+
+
 def _entry(cfg, nps: float, backend: str) -> dict:
     """Per-config bench entry: both throughput axes + roofline."""
     tps = nps / cfg.n
@@ -551,7 +564,6 @@ def main():
         # a mere retried transient.
         from gossip_protocol_tpu.service import chaos_replay
         import jax
-        import os as _os
         chaos_d = 2 if (jax.device_count() > 1 and sv_lanes % 2 == 0) \
             else 1
         chaos_mesh = None
@@ -584,11 +596,7 @@ def main():
             "schedule_digest": ch["schedule_digest"],
             "outcome_digest": ch["outcome_digest"],
             "parity_checked": ch["parity_checked"],
-            "env": {
-                "device_count": jax.device_count(),
-                "jax_backend": jax.default_backend(),
-                "xla_flags": _os.environ.get("XLA_FLAGS", ""),
-            },
+            "env": _env_provenance(),
         }
         if jax.device_count() > 1:
             # lane-mesh serving (parallel/fleet_mesh.py) at EQUAL total
@@ -616,6 +624,22 @@ def main():
                                       mesh=make_lane_mesh(d),
                                       sequential=seq_leg)
                 secondary["service_replay_mixed_mesh"] = _sv_entry(sv_m)
+
+        # open-loop traffic plane (PR 7, docs/SERVING.md "Open-loop
+        # traffic & SLOs"): seeded Poisson arrivals wall-paced through
+        # the pipelined scheduler at a swept ladder of offered loads —
+        # p50/p99 per priority class, per-class deadline-miss rates,
+        # the measured saturation point, the deadline-aware-early-
+        # flush ON/OFF miss-rate comparison on one schedule, and the
+        # virtual-clock determinism gate (identical seed -> identical
+        # arrival + outcome digests across two runs).  measure_point
+        # raises on any stranded handle or non-deadline failure, so
+        # this entry existing is itself a completion gate.
+        from gossip_protocol_tpu.service.loadbench import \
+            load_openloop_bench
+        lb = load_openloop_bench(smoke=smoke)
+        lb["env"] = _env_provenance()
+        secondary["service_load_openloop"] = lb
 
     secondary.update({
         f"n{n_drop}_overlay_drop10": _overlay_entry(drop, backend),
@@ -651,10 +675,7 @@ def main():
             pl_1m.node_ticks_per_second / REFERENCE_NODE_TICKS_PER_S, 3)
 
     # provenance: every BENCH json must say what machine shape produced
-    # it — the mesh numbers are meaningless without the live (virtual)
-    # device count and the XLA flags that forced it
-    import os
-
+    # it (_env_provenance; the headline env also samples device names)
     import jax
     nps = overlay.node_ticks_per_second
     payload = {
@@ -665,11 +686,9 @@ def main():
         "backend": backend,
         "ticks_per_s": round(nps / n_overlay, 1),
         "env": {
-            "device_count": jax.device_count(),
-            "jax_backend": jax.default_backend(),
+            **_env_provenance(),
             "devices": [str(d) for d in jax.devices()[:2]]
             + (["..."] if jax.device_count() > 2 else []),
-            "xla_flags": os.environ.get("XLA_FLAGS", ""),
         },
         "headline": _overlay_entry(overlay, backend),
         "secondary": secondary,

@@ -9,7 +9,12 @@ results bit-identical to solo runs, with per-request latency and
 per-dispatch occupancy metrics.  With ``mesh=`` (a lane mesh,
 parallel/fleet_mesh.py) every dispatch is served from the whole
 mesh: capacity ``max_batch x n_devices``, shard-divisible padding,
-mesh-keyed program caches.  See docs/SERVING.md.
+mesh-keyed program caches.  The open-loop traffic plane
+(service/traffic.py + service/slo.py + service/loadbench.py) drives
+the scheduler with seeded arrival processes under SLO-aware
+scheduling: priority classes with per-class deadlines, deadline-aware
+early flush, per-tenant quotas — every arrival schedule replayable
+digest-for-digest.  See docs/SERVING.md.
 """
 
 from .bucket import bucket_key, pad_configs
@@ -22,9 +27,13 @@ from .replay import (Template, build_trace, chaos_replay,
 from .resilience import (BreakerPolicy, BucketQuarantined, CircuitBreaker,
                          DeadlineExceeded, DispatchFailed,
                          PoisonedLaneError, RetryPolicy, ServiceError,
-                         ShedRejection, solo_execute, solo_run,
-                         validate_lane)
+                         ShedRejection, TenantQuotaExceeded,
+                         solo_execute, solo_run, validate_lane)
 from .scheduler import PAD_POLICIES, FleetService
+from .slo import ClassPolicy, SLOPolicy, default_slo
+from .traffic import (ARRIVAL_KINDS, Arrival, TrafficPattern,
+                      TrafficSchedule, VirtualClock, closed_schedule,
+                      make_schedule, outcome_digest, run_schedule)
 from .types import MODES, RequestHandle, RequestMetrics, SimRequest
 
 __all__ = [
@@ -39,4 +48,10 @@ __all__ = [
     "CircuitBreaker", "ServiceError", "ShedRejection",
     "DeadlineExceeded", "DispatchFailed", "PoisonedLaneError",
     "BucketQuarantined", "solo_execute", "solo_run", "validate_lane",
+    # the open-loop traffic + SLO plane (PR 7): seeded arrival
+    # processes, the virtual-clock driver, priority classes, quotas
+    "ARRIVAL_KINDS", "Arrival", "TrafficPattern", "TrafficSchedule",
+    "VirtualClock", "closed_schedule", "make_schedule",
+    "outcome_digest", "run_schedule", "ClassPolicy", "SLOPolicy",
+    "default_slo", "TenantQuotaExceeded",
 ]

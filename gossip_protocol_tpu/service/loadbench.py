@@ -1,0 +1,350 @@
+"""Latency-under-load harness: the open-loop serving bench.
+
+The closed-loop replay (service/replay.py) answers "how fast can the
+service drain a fixed batch of work"; this module answers the question
+the north star actually asks: **what latency does a request see at a
+given offered load, and where does the service saturate?**  It drives
+the pipelined scheduler with seeded open-loop arrival schedules
+(service/traffic.py) at a swept ladder of offered loads and reports,
+per load point, p50/p99 latency per priority class, per-class
+deadline-miss rates, occupancy, shed counts, and how far submissions
+fell behind schedule — plus the measured saturation point (the first
+offered load the service cannot absorb).
+
+Three probes, composed by :func:`load_openloop_bench` into the
+``secondary.service_load_openloop`` BENCH entry:
+
+* :func:`sweep` — wall-paced load ladder (fractions of a measured
+  closed-loop capacity probe), >= 4 points, each a fresh service over
+  process-cached programs so points don't share stats windows;
+* :func:`slo_ab` — the same schedule served twice at one load,
+  deadline-aware early flush ON vs OFF (identical classes and
+  deadlines both legs): the miss-rate delta is the SLO scheduler's
+  measured value, not a modeling claim;
+* :func:`replay_check` — the determinism gate: one seed driven twice
+  through VIRTUAL pacing (service clock = the schedule's virtual
+  clock, harvest pinned off, wall estimate pinned), arrival and
+  outcome digests must match run-for-run — load runs are replayable
+  regression tests, exactly like chaos runs.
+
+Fault-free load runs hold the chaos plane's completion discipline:
+every handle must be terminal after the drain, and the only tolerated
+failures are the typed load outcomes (DeadlineExceeded expiry,
+ShedRejection at admission).  Anything else raises — an engine error
+must never be laundered into a "miss rate".
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import replace
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .replay import Template, grader_templates, overlay_templates
+from .resilience import DeadlineExceeded
+from .scheduler import FleetService
+from .slo import SLOPolicy, default_slo
+from .traffic import (TrafficPattern, VirtualClock, make_schedule,
+                      outcome_digest, run_schedule)
+
+
+def load_catalog(n: int = 512, ticks: int = 96) -> list[Template]:
+    """The mixed scenario catalog the load plane serves: the grader
+    tier (exact dense N=10 course scenarios) + the overlay scale tier
+    (fail / churn / drop10) — the same six templates as the replay
+    acceptance stream, arriving open-loop instead of all at once."""
+    return grader_templates() + overlay_templates(n=n, ticks=ticks)
+
+
+def warm_service(svc: FleetService, templates: Sequence[Template]) -> None:
+    """Compile + execute every distinct template's bucket program once
+    (also seeds the per-bucket wall EWMAs the early flush reads)."""
+    done = set()
+    for tpl in templates:
+        if tpl.name in done:
+            continue
+        done.add(tpl.name)
+        svc.warm(tpl.cfg, tpl.mode)
+
+
+def probe_capacity_rps(templates: Sequence[Template],
+                       n_requests: int = 48, max_batch: int = 8,
+                       seed: int = 0, warm_lap: bool = True) -> float:
+    """Closed-loop burst probe: all ``n_requests`` at t=0, drain; the
+    achieved completion rate is the service's max sustainable
+    throughput for this catalog — the ladder's 1.0x anchor.  With
+    ``warm_lap`` an untimed identical lap runs first (compilation and
+    the first-lap trace/placement-cache costs are not steady-state
+    serving, docs/PERF.md §11)."""
+    pattern = TrafficPattern(kind="closed", rate_rps=float(n_requests))
+    laps = (0, 1) if warm_lap else (1,)
+    rate = 0.0
+    for lap in laps:
+        svc = FleetService(max_batch=max_batch)
+        warm_service(svc, templates)
+        sched = make_schedule(templates, n_requests, pattern,
+                              seed=seed + lap)
+        handles, rec = run_schedule(svc, sched, pace="wall")
+        done = sum(1 for h in handles if h is not None and h.done
+                   and not h.failed)
+        rate = done / rec["wall_s"]
+    return rate
+
+
+def measure_point(templates: Sequence[Template], n_requests: int,
+                  rate_rps: float, seed: int, slo: SLOPolicy,
+                  kind: str = "poisson", max_batch: int = 8,
+                  max_wait_s: Optional[float] = 8.0,
+                  early_flush: Optional[bool] = None,
+                  tenant_quota: Optional[int] = None,
+                  max_queue_depth: Optional[int] = None) -> dict:
+    """One wall-paced open-loop run at one offered load; returns the
+    load point's row.  Raises on any non-terminal handle or any
+    failure that is not a typed load outcome (deadline expiry /
+    admission shed)."""
+    eff_slo = slo if early_flush is None \
+        else slo.with_early_flush(early_flush)
+    pattern = TrafficPattern(kind=kind, rate_rps=rate_rps)
+    sched = make_schedule(templates, n_requests, pattern, seed=seed,
+                          class_mix=eff_slo.class_mix())
+    svc = FleetService(max_batch=max_batch, max_wait_s=max_wait_s,
+                       slo=eff_slo, tenant_quota=tenant_quota,
+                       max_queue_depth=max_queue_depth)
+    # warm before the clock starts: programs are process-cached after
+    # the capacity probe, but warm() also seeds the per-bucket wall
+    # EWMAs the deadline-aware early flush reads — a cold estimate
+    # would disable the SLO scheduler for the first dispatches
+    warm_service(svc, templates)
+    handles, rec = run_schedule(svc, sched, pace="wall")
+    stats = svc.stats()
+
+    submitted = [h for h in handles if h is not None]
+    stranded = [h for h in submitted if not h.done]
+    if stranded:
+        raise RuntimeError(
+            f"open-loop run left {len(stranded)} non-terminal handles "
+            f"of {len(submitted)} (rate {rate_rps:.2f} rps, seed "
+            f"{seed}); the drain guarantee is broken")
+    bad = [h for h in submitted if h.failed
+           and not isinstance(h.exception(), DeadlineExceeded)]
+    if bad:
+        raise RuntimeError(
+            f"open-loop run had {len(bad)} non-deadline failures "
+            f"(first: {bad[0].exception()!r}); engine errors must not "
+            "be reported as load outcomes")
+
+    completed = [h for h in submitted if h.done and not h.failed]
+    expired = [h for h in submitted if h.failed]
+    # per-class rows from the handles themselves (each point is a
+    # fresh service, but handle-level accounting keeps the row
+    # independent of stats windowing entirely)
+    classes: dict[str, dict] = {}
+    for a, h in zip(sched.arrivals, handles):
+        c = classes.setdefault(a.priority, {
+            "requests": 0, "completed": 0, "expired": 0, "shed": 0,
+            "deadline_misses": 0, "_lat": []})
+        c["requests"] += 1
+        if h is None:
+            c["shed"] += 1
+            continue
+        if h.failed:
+            c["expired"] += 1
+            c["deadline_misses"] += 1
+            continue
+        c["completed"] += 1
+        c["_lat"].append(h.metrics.latency_s)
+        if h.metrics.deadline_missed:
+            c["deadline_misses"] += 1
+    for c in classes.values():
+        lat = np.asarray(c.pop("_lat"), dtype=np.float64)
+        c["latency_p50_s"] = round(float(np.percentile(lat, 50)), 4) \
+            if lat.size else 0.0
+        c["latency_p99_s"] = round(float(np.percentile(lat, 99)), 4) \
+            if lat.size else 0.0
+        terminal = c["completed"] + c["expired"]
+        c["deadline_miss_rate"] = \
+            round(c["deadline_misses"] / terminal, 4) if terminal else 0.0
+
+    lat_all = np.asarray([h.metrics.latency_s for h in completed],
+                         dtype=np.float64)
+    missed = sum(1 for h in completed if h.metrics.deadline_missed) \
+        + len(expired)
+    terminal = len(completed) + len(expired)
+    return {
+        "offered_rps": round(rate_rps, 3),
+        "achieved_rps": round(len(completed) / rec["wall_s"], 3)
+        if rec["wall_s"] > 0 else 0.0,
+        "arrival_kind": kind,
+        "requests": len(sched),
+        "completed": len(completed),
+        "expired": len(expired),
+        "shed": len(rec["sheds"]),
+        "latency_p50_s": round(float(np.percentile(lat_all, 50)), 4)
+        if lat_all.size else 0.0,
+        "latency_p99_s": round(float(np.percentile(lat_all, 99)), 4)
+        if lat_all.size else 0.0,
+        "deadline_miss_rate": round(missed / terminal, 4)
+        if terminal else 0.0,
+        "mean_occupancy": stats["mean_occupancy"],
+        "slo_early_flushes": stats["slo_early_flushes"],
+        "max_lag_s": round(rec["max_lag_s"], 3),
+        "span_s": round(sched.span_s, 3),
+        "wall_s": round(rec["wall_s"], 3),
+        "classes": dict(sorted(classes.items())),
+    }
+
+
+#: a load point saturates when it completes less than this fraction of
+#: its offered rate...
+SATURATION_FRAC = 0.9
+#: ...AND its makespan overran the schedule span by this factor (a
+#: backlog that outlived the arrivals).  The second condition matters:
+#: every finite run pays a drain tail after the last arrival, and at
+#: small request counts that tail alone pushes achieved below offered
+#: even when the service is nowhere near saturated.
+SATURATION_SPAN_RATIO = 1.2
+
+
+def _saturated(row: dict) -> bool:
+    return (row["achieved_rps"] < SATURATION_FRAC * row["offered_rps"]
+            and row["wall_s"] > SATURATION_SPAN_RATIO * row["span_s"])
+
+
+def sweep(templates: Sequence[Template], n_requests: int,
+          capacity_rps: float, seed: int, slo: SLOPolicy,
+          fracs: Sequence[float] = (0.25, 0.5, 0.75, 1.0, 1.5),
+          **point_kw) -> dict:
+    """The offered-load ladder: one :func:`measure_point` per fraction
+    of the probed capacity (distinct seeds per point — distinct
+    schedules, like the bench's distinct rep seeds), plus the measured
+    saturation point: the first offered load the service could not
+    absorb (:func:`_saturated` — completion rate below
+    ``SATURATION_FRAC`` of offered AND the backlog outlived the
+    arrival schedule)."""
+    rows = []
+    for i, f in enumerate(fracs):
+        r = measure_point(templates, n_requests,
+                          rate_rps=capacity_rps * f,
+                          seed=seed + i, slo=slo, **point_kw)
+        r["saturated"] = _saturated(r)
+        rows.append(r)
+    saturation = next((r["offered_rps"] for r in rows
+                       if r["saturated"]), None)
+    return {
+        "capacity_probe_rps": round(capacity_rps, 3),
+        "load_fracs": list(fracs),
+        "points": rows,
+        "saturation_offered_rps": saturation,
+        "max_achieved_rps": max(r["achieved_rps"] for r in rows),
+    }
+
+
+def slo_ab(templates: Sequence[Template], n_requests: int,
+           rate_rps: float, seed: int, slo: SLOPolicy,
+           **point_kw) -> dict:
+    """Deadline-aware batch formation ON vs OFF on the SAME schedule
+    (same seed, same classes and deadlines — only the early-flush rule
+    differs).  The report's ``improved`` is the acceptance gate:
+    strictly fewer deadline misses with the SLO scheduler on."""
+    on = measure_point(templates, n_requests, rate_rps, seed, slo,
+                       early_flush=True, **point_kw)
+    off = measure_point(templates, n_requests, rate_rps, seed, slo,
+                        early_flush=False, **point_kw)
+    return {
+        "offered_rps": round(rate_rps, 3),
+        "on": on, "off": off,
+        "miss_rate_on": on["deadline_miss_rate"],
+        "miss_rate_off": off["deadline_miss_rate"],
+        "improved": on["deadline_miss_rate"] < off["deadline_miss_rate"],
+    }
+
+
+def replay_check(templates: Sequence[Template], n_requests: int,
+                 rate_rps: float, seed: int, slo: SLOPolicy,
+                 max_batch: int = 8,
+                 max_wait_s: Optional[float] = 8.0,
+                 assumed_wall_s: float = 0.5, runs: int = 2) -> dict:
+    """The load plane's replay gate: the same seed driven ``runs``
+    times through VIRTUAL pacing must produce identical arrival AND
+    outcome digests.  Determinism needs three pins (all documented in
+    service/traffic.py): the service clock is the schedule's virtual
+    clock, the idle harvest is off (``pump_harvest=False``), and the
+    early-flush wall estimate is the policy's pinned value rather than
+    a measured EWMA."""
+    det_slo = replace(slo, assumed_dispatch_wall_s=assumed_wall_s)
+    digests = []
+    for _ in range(runs):
+        vc = VirtualClock()
+        svc = FleetService(max_batch=max_batch, max_wait_s=max_wait_s,
+                           slo=det_slo, clock=vc, sleep=vc.sleep,
+                           pump_harvest=False)
+        warm_service(svc, templates)
+        sched = make_schedule(templates, n_requests,
+                              TrafficPattern(rate_rps=rate_rps),
+                              seed=seed, class_mix=det_slo.class_mix())
+        handles, rec = run_schedule(svc, sched, pace="virtual",
+                                    clock=vc)
+        digests.append((sched.digest(),
+                        outcome_digest(sched, handles, rec["sheds"])))
+    return {
+        "seed": seed,
+        "runs": runs,
+        "arrival_digest": digests[0][0],
+        "outcome_digest": digests[0][1],
+        "deterministic": len(set(digests)) == 1,
+    }
+
+
+def load_openloop_bench(smoke: bool = False, seed: int = 20260804,
+                        now=time.perf_counter) -> dict:
+    """The whole open-loop story as one BENCH entry: capacity probe ->
+    load ladder with saturation -> SLO A/B at a partial-batch load ->
+    the virtual-clock determinism gate.  The caller (bench.py) adds
+    env provenance."""
+    if smoke:
+        templates = load_catalog(n=256, ticks=48)
+        n_probe, n_point = 16, 24
+        fracs = (0.3, 0.75, 1.1, 1.6)
+    else:
+        templates = load_catalog(n=512, ticks=96)
+        n_probe, n_point = 48, 90
+        fracs = (0.25, 0.5, 0.75, 1.0, 1.5)
+    slo = default_slo()
+    t0 = now()
+    cap = probe_capacity_rps(templates, n_requests=n_probe)
+    sw = sweep(templates, n_point, cap, seed=seed, slo=slo, fracs=fracs)
+    # the A/B load: low enough that buckets stay partial (early flush
+    # is the only way a latency-class request makes its deadline),
+    # high enough that the stream is not trivial
+    ab = slo_ab(templates, n_point, rate_rps=0.4 * cap, seed=seed + 100,
+                slo=slo)
+    rc = replay_check(templates, max(12, n_point // 3),
+                      rate_rps=0.5 * cap, seed=seed + 200, slo=slo)
+    # the gates are ENFORCED, not just recorded: a bench json must not
+    # quietly carry a regressed acceptance property
+    if not rc["deterministic"]:
+        raise RuntimeError(
+            "open-loop replay check failed: the same seed produced "
+            "different arrival/outcome digests across two virtual-"
+            "paced runs — the load plane lost its determinism pins")
+    if not smoke and not ab["improved"]:
+        # smoke streams (24 requests over a fast catalog) are too
+        # small to miss deadlines at all, so both legs tie at 0 there;
+        # at full scale a tie or inversion is a real SLO regression
+        raise RuntimeError(
+            f"SLO A/B regression: deadline-miss rate with early flush "
+            f"ON ({ab['miss_rate_on']}) is not strictly below OFF "
+            f"({ab['miss_rate_off']}) at {ab['offered_rps']} rps")
+    entry = {
+        "pattern": "poisson",
+        "slo_classes": {name: {"deadline_s": c.deadline_s,
+                               "weight": c.weight}
+                        for name, c in slo.classes.items()},
+        **sw,
+        "slo_ab": ab,
+        "replay_check": rc,
+        "bench_wall_s": round(now() - t0, 1),
+    }
+    return entry

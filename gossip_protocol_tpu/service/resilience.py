@@ -67,6 +67,26 @@ class ShedRejection(ServiceError):
             f"max_queue_depth={max_queue_depth}; drain or retry later")
 
 
+class TenantQuotaExceeded(ShedRejection):
+    """Admission refused for ONE tenant: it already holds
+    ``tenant_quota`` queued requests (the per-tenant layer on top of
+    ``max_queue_depth`` — one hot tenant cannot starve the queue).
+    A ShedRejection, so callers that back off on global shedding
+    handle it unchanged; nothing queued is ever dropped."""
+
+    def __init__(self, tenant: str, queued: int, quota: int):
+        self.tenant = tenant
+        self.queued = queued
+        self.quota = quota
+        # ShedRejection's fields, for callers that read them generically
+        self.pending = queued
+        self.max_queue_depth = quota
+        ServiceError.__init__(
+            self, f"request shed for tenant {tenant!r}: {queued} "
+            f"requests already queued >= tenant_quota={quota}; other "
+            "tenants are unaffected")
+
+
 class DeadlineExceeded(ServiceError):
     """The request's deadline passed before it could be served."""
 

@@ -49,6 +49,20 @@ graceful mesh degradation: a device loss shrinks the lane mesh
 (parallel/fleet_mesh.py ``shrink_mesh``) and rebuilds the bucket's
 programs through the mesh-keyed caches.  All of it is exercised
 deterministically by the seeded fault plane in service/faults.py.
+
+Traffic/SLO plane (PR 7, docs/SERVING.md "Open-loop traffic & SLOs"):
+the scheduler serves OPEN-loop request streams (service/traffic.py —
+seeded Poisson/burst/diurnal arrivals, every arrival a pure function
+of ``(seed, index)``) with SLO-aware scheduling (service/slo.py):
+priority classes supply per-class default deadlines, ``pump()``
+flushes a partial bucket EARLY when its tightest deadline minus the
+bucket's estimated dispatch wall (a per-bucket EWMA of the PR-6 wall
+decomposition, seeded by ``warm()``) says the batch must go now, and
+per-tenant admission quotas (``tenant_quota``) layer on
+``max_queue_depth`` so one hot tenant sheds typed instead of starving
+the rest.  ``stats()`` splits latency windows per priority class and
+``pump_harvest=False`` pins the idle in-flight harvest off for
+deterministic virtual-clock traffic replays.
 """
 
 from __future__ import annotations
@@ -69,7 +83,8 @@ from .faults import FaultInjector, InjectedCompileFailure, \
 from .resilience import (BreakerPolicy, BucketQuarantined, CircuitBreaker,
                          DeadlineExceeded, DispatchFailed,
                          PoisonedLaneError, RetryPolicy, ShedRejection,
-                         solo_run, validate_lane)
+                         TenantQuotaExceeded, solo_run, validate_lane)
+from .slo import SLOPolicy
 from .types import MODES, RequestHandle, RequestMetrics, SimRequest
 
 #: padding policies: "full" pads every dispatch to ``max_batch`` (one
@@ -133,7 +148,10 @@ class FleetService:
                  max_queue_depth: Optional[int] = None,
                  default_deadline_s: Optional[float] = None,
                  degrade_to_solo: bool = True, sleep=time.sleep,
-                 pipeline: Optional[bool] = None):
+                 pipeline: Optional[bool] = None,
+                 slo: Optional[SLOPolicy] = None,
+                 tenant_quota: Optional[int] = None,
+                 pump_harvest: Optional[bool] = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if pad_policy not in PAD_POLICIES:
@@ -142,6 +160,9 @@ class FleetService:
         if max_queue_depth is not None and max_queue_depth < 1:
             raise ValueError(f"max_queue_depth must be >= 1 or None, "
                              f"got {max_queue_depth}")
+        if tenant_quota is not None and tenant_quota < 1:
+            raise ValueError(f"tenant_quota must be >= 1 or None, "
+                             f"got {tenant_quota}")
         self.max_batch = max_batch
         self.max_wait_s = max_wait_s
         self.pad_policy = pad_policy
@@ -161,6 +182,25 @@ class FleetService:
         self.default_deadline_s = default_deadline_s
         self.degrade_to_solo = degrade_to_solo
         self._sleep = sleep
+        #: the SLO plane (service/slo.py): priority classes with
+        #: per-class default deadlines, and — when
+        #: ``slo.early_flush`` — deadline-aware batch formation: pump
+        #: flushes a partial bucket early when its tightest deadline
+        #: minus the bucket's estimated dispatch wall says it must go
+        #: now to make it
+        self.slo = slo
+        #: per-tenant admission quota, layered on ``max_queue_depth``:
+        #: a tenant already holding this many QUEUED requests sheds
+        #: with the typed TenantQuotaExceeded (a ShedRejection) —
+        #: queued work is never dropped, and one hot tenant cannot
+        #: starve the rest of the queue
+        self.tenant_quota = tenant_quota
+        #: the idle in-flight harvest in ``pump()`` polls real device
+        #: readiness — wall-time-dependent by nature.  None (default):
+        #: enabled exactly when no injector is active (the PR-6
+        #: behavior); False pins it off for deterministic virtual-clock
+        #: traffic runs (service/traffic.py) even without an injector
+        self.pump_harvest = pump_harvest
         #: pipelined dispatch (the PR 6 tentpole, default ON): a
         #: dispatch STAGES its batch, waits for the previous in-flight
         #: batch's program to finish, dispatches its own program onto
@@ -194,6 +234,25 @@ class FleetService:
         self._dispatches: deque = deque(maxlen=max(1, stats_window // 8))
         self._dispatch_count = 0
         self._bucket_stats: dict[tuple, dict] = {}
+        # per-priority-class observability (the open-loop plane): one
+        # bounded latency window PER class — a single global window
+        # mixes classes and epochs under sustained mixed traffic, so
+        # per-class p50/p99 would be meaningless — plus lifetime
+        # per-class terminal counters; the aggregate fields above are
+        # unchanged
+        self._stats_window = stats_window
+        self._class_lat: dict[str, deque] = {}
+        self._class_stats: dict[str, dict] = {}
+        self._tenant_shed: dict[str, int] = {}
+        # queued-request count per tenant, maintained at every queue
+        # mutation (enqueue / pop / requeue / expiry) so quota
+        # admission is O(1) instead of a full queue scan per submit
+        self._tenant_queued: dict[str, int] = {}
+        self._early_flushes = 0
+        # per-bucket dispatch-wall EWMA (seconds), seeded by warm():
+        # the early-flush estimate (PR 6's wall decomposition already
+        # measures the wall; this just remembers it per bucket)
+        self._bucket_wall: dict[tuple, float] = {}
         # failure-domain counters (lifetime-exact, like the request/
         # dispatch counters; the windowed view rides the _dispatches
         # entries' "retries" field)
@@ -209,45 +268,79 @@ class FleetService:
     # ---- admission ---------------------------------------------------
     def submit(self, cfg: SimConfig, seed: Optional[int] = None,
                mode: str = "trace",
-               deadline_s: Optional[float] = None) -> RequestHandle:
+               deadline_s: Optional[float] = None,
+               priority: Optional[str] = None,
+               tenant: Optional[str] = None) -> RequestHandle:
         """Admit one simulation request; returns immediately.
 
         ``seed`` is sugar for ``cfg.replace(seed=seed)``.  Admission
         also runs the cooperative flush pass, so a submit can complete
         earlier requests (its own too, when it fills a batch).
 
-        ``deadline_s`` (or the service's ``default_deadline_s``) is a
-        relative latency budget on the service clock: a request still
-        queued past it fails fast with :class:`DeadlineExceeded`; one
-        that completes late is delivered with
-        ``metrics.deadline_missed`` set.  When the queue already holds
-        ``max_queue_depth`` requests, admission sheds with the typed
+        ``priority`` names an SLO class (service/slo.py) when the
+        service carries an ``slo`` policy: it is validated against the
+        policy and supplies the request's default deadline; without a
+        policy it is a free-form label (default ``"default"``) used
+        only for per-class stats.  ``tenant`` attributes the request
+        for per-tenant admission quotas (``tenant_quota``) and shed
+        accounting.
+
+        ``deadline_s`` (or, absent it, the class default when an
+        ``slo`` policy rides — the policy OWNS deadlines, so a
+        deadline-less class stays deadline-less — or the service's
+        ``default_deadline_s`` on policy-less services) is a relative
+        latency budget on the service clock: a request still queued
+        past it fails fast with :class:`DeadlineExceeded`; one that
+        completes late is delivered with ``metrics.deadline_missed``
+        set.  When the queue already holds ``max_queue_depth``
+        requests — or the tenant already holds ``tenant_quota`` queued
+        requests — admission sheds with a typed
         :class:`ShedRejection` — load is never shed by silently
         dropping something already queued.
         """
         if mode not in MODES:
             raise ValueError(f"unknown mode {mode!r}; expected one "
                              f"of {MODES}")
+        if self.slo is not None:
+            priority = self.slo.resolve(priority)
+        elif priority is None:
+            priority = "default"
         if self.max_queue_depth is not None \
                 and self.pending >= self.max_queue_depth:
             self._failures["shed"] += 1
             raise ShedRejection(self.pending, self.max_queue_depth)
+        if self.tenant_quota is not None and tenant is not None:
+            held = self._tenant_queued.get(tenant, 0)
+            if held >= self.tenant_quota:
+                self._failures["shed"] += 1
+                self._tenant_shed[tenant] = \
+                    self._tenant_shed.get(tenant, 0) + 1
+                raise TenantQuotaExceeded(tenant, held, self.tenant_quota)
         if seed is not None:
             cfg = cfg.replace(seed=int(seed))
         key = bucket_key(cfg, mode)
         now = self.clock()
-        budget = deadline_s if deadline_s is not None \
-            else self.default_deadline_s
+        budget = deadline_s
+        if budget is None:
+            # an SLO policy OWNS the deadline decision: a class
+            # declared deadline-less STAYS deadline-less — the
+            # service-wide default applies only on policy-less
+            # services (otherwise ClassPolicy(deadline_s=None) could
+            # not express "throughput-only" at all)
+            budget = self.slo.deadline_for(priority) \
+                if self.slo is not None else self.default_deadline_s
         req = SimRequest(rid=self._next_rid, cfg=cfg, mode=mode,
                          bucket=key, submit_s=now,
                          deadline_s=(now + budget
-                                     if budget is not None else None))
+                                     if budget is not None else None),
+                         priority=priority, tenant=tenant)
         if req.deadline_s is not None:
             self._has_deadlines = True
         self._next_rid += 1
         handle = RequestHandle(request=req, _service=self)
         self._handles[req.rid] = handle
         self._queues.setdefault(key, deque()).append(req)
+        self._tenant_note(req.tenant, +1)
         self._filler.setdefault(key, cfg)
         self._bucket_stats.setdefault(key, {"requests": 0, "dispatches": 0,
                                             "builds": 0})
@@ -265,34 +358,90 @@ class FleetService:
     def pump(self) -> int:
         """One cooperative scheduling pass; returns dispatches made.
 
-        Flushes every bucket that is full (:attr:`capacity`) and every
-        bucket whose oldest request has waited past ``max_wait_s``.
-        A pump that made no dispatch also HARVESTS a finished
-        in-flight batch (non-blocking ``is_ready`` check), so a
-        poll-driven caller sees completions during idle periods
-        without forcing a flush — except under an active fault
-        injector: a readiness check is wall-time-dependent, and a
+        Flushes every bucket that is full (:attr:`capacity`), every
+        bucket whose oldest request has waited past ``max_wait_s``,
+        and — under an ``slo`` policy with ``early_flush`` — every
+        bucket whose tightest deadline minus its estimated dispatch
+        wall says a partial batch must dispatch NOW to make its SLO
+        (:meth:`_should_flush_early`).  A pump that made no dispatch
+        also HARVESTS a finished in-flight batch (non-blocking
+        ``is_ready`` check), so a poll-driven caller sees completions
+        during idle periods without forcing a flush — except when
+        :meth:`_harvest_enabled` says no: under an active fault
+        injector (a readiness check is wall-time-dependent, and a
         fault surfacing at resolve would consume retry attempt
         indices at a timing-dependent point, breaking the chaos
-        plane's digest-for-digest replayability.
+        plane's digest-for-digest replayability), or when
+        ``pump_harvest=False`` pins it off for deterministic
+        virtual-clock traffic runs (service/traffic.py) that have no
+        injector but still must not stamp completion times at
+        wall-dependent points.
         """
         n = 0
-        now = self.clock()
-        self._expire_deadlines(now)
+        self._expire_deadlines(self.clock())
         for key in list(self._queues):
             q = self._queues[key]
             while len(q) >= self.capacity:
                 self._dispatch(key)
                 n += 1
+            # re-read the clock per bucket: a multi-second dispatch
+            # above (or for an earlier bucket) can erode another
+            # bucket's deadline margin within this same pass — a
+            # stale timestamp would miss exactly the flush-now window
+            # the SLO check exists to catch.  (On a virtual clock the
+            # re-read returns the same value: determinism unaffected.)
+            now = self.clock()
             if (q and self.max_wait_s is not None
                     and now - q[0].submit_s >= self.max_wait_s):
                 self._dispatch(key)
                 n += 1
-        if n == 0 and self.injector is None \
+            if q and self._should_flush_early(key, q, now):
+                self._early_flushes += 1
+                self._dispatch(key)
+                n += 1
+        if n == 0 and self._harvest_enabled() \
                 and self._inflight is not None \
                 and self._inflight.pending.is_ready():
             self.resolve_inflight()
         return n
+
+    def _harvest_enabled(self) -> bool:
+        """Whether an idle ``pump()`` may resolve a ready in-flight
+        batch.  Explicit ``pump_harvest`` wins; the default enables it
+        exactly when no fault injector is active."""
+        if self.pump_harvest is not None:
+            return bool(self.pump_harvest)
+        return self.injector is None
+
+    def _should_flush_early(self, key: tuple, q, now: float) -> bool:
+        """Deadline-aware batch formation (service/slo.py): True when
+        the bucket's tightest queued deadline leaves no more margin
+        than the estimated dispatch wall (times the policy's safety
+        factor, plus its fixed margin).  Requests whose deadline
+        already passed were expired by ``_expire_deadlines`` before
+        this runs, so the margin here is positive."""
+        if self.slo is None or not self.slo.early_flush:
+            return False
+        rem = self._min_remaining(q, now)
+        if rem is None:
+            return False
+        est = self._est_wall(key)
+        return rem <= est * self.slo.safety_factor + self.slo.margin_s
+
+    def _est_wall(self, key: tuple) -> float:
+        """Estimated dispatch wall for one bucket: the pinned value
+        when the SLO policy carries one (deterministic replays), else
+        the bucket's measured EWMA (seeded by ``warm()``), else the
+        mean over buckets that HAVE dispatched, else 0 (flush only on
+        the fixed margin until the first wall is measured)."""
+        if self.slo is not None \
+                and self.slo.assumed_dispatch_wall_s is not None:
+            return self.slo.assumed_dispatch_wall_s
+        if key in self._bucket_wall:
+            return self._bucket_wall[key]
+        if self._bucket_wall:
+            return sum(self._bucket_wall.values()) / len(self._bucket_wall)
+        return 0.0
 
     def flush(self, bucket: Optional[tuple] = None) -> int:
         """Dispatch everything pending (in one bucket, or all), then
@@ -367,6 +516,8 @@ class FleetService:
         front and propagate."""
         q = self._queues[key]
         reqs = [q.popleft() for _ in range(min(len(q), self.capacity))]
+        for r in reqs:
+            self._tenant_note(r.tenant, -1)
         try:
             if self.pipeline:
                 self._serve_batch_pipelined(key, reqs)
@@ -387,6 +538,8 @@ class FleetService:
             unresolved = [r for r in reqs if r.rid in self._handles
                           and r.rid not in keep and r.rid not in queued]
             q.extendleft(reversed(unresolved))
+            for r in unresolved:
+                self._tenant_note(r.tenant, +1)
             self._abort_inflight()
             # requeues may have landed from several points (a failing
             # resolve, the abort above, this backstop); restore submit
@@ -406,6 +559,7 @@ class FleetService:
         back = [r for r in reqs if r.rid in self._handles]
         for r in back:
             self._handles[r.rid]._launched = False
+            self._tenant_note(r.tenant, +1)
         q.extendleft(reversed(back))
 
     def _abort_inflight(self) -> None:
@@ -717,6 +871,13 @@ class FleetService:
         fetch = float(fleet.fetch_seconds)
         wall = float(fleet.wall_seconds)
         now = self.clock()
+        # fold this dispatch's wall into the bucket's EWMA — the
+        # early-flush estimate (service/slo.py) for the NEXT partial
+        # batch in this bucket
+        alpha = self.slo.wall_ewma_alpha if self.slo is not None else 0.3
+        prev = self._bucket_wall.get(key)
+        self._bucket_wall[key] = wall if prev is None \
+            else (1.0 - alpha) * prev + alpha * wall
         for req, lane in zip(reqs, fleet.lanes):
             missed = req.deadline_s is not None and now > req.deadline_s
             if missed:
@@ -727,8 +888,10 @@ class FleetService:
                 latency_s=now - req.submit_s, batch=len(reqs),
                 padded_batch=width, occupancy=occupancy,
                 cache_hit=builds == 0, builds=builds, retries=retries,
-                deadline_missed=missed))
+                deadline_missed=missed, priority=req.priority,
+                tenant=req.tenant))
             self._latencies.append(now - req.submit_s)
+            self._note_class_terminal(req, now - req.submit_s, missed)
         self._completed += len(reqs)
         self._dispatches.append({"bucket": key, "batch": len(reqs),
                                  "width": width, "occupancy": occupancy,
@@ -777,8 +940,10 @@ class FleetService:
                 run_wall_s=now - t0, latency_s=now - req.submit_s,
                 batch=1, padded_batch=1, occupancy=1.0,
                 cache_hit=False, builds=0, retries=retries,
-                degraded=True, deadline_missed=missed))
+                degraded=True, deadline_missed=missed,
+                priority=req.priority, tenant=req.tenant))
             self._latencies.append(now - req.submit_s)
+            self._note_class_terminal(req, now - req.submit_s, missed)
             self._completed += 1
 
     def _degrade_mesh(self) -> None:
@@ -801,6 +966,7 @@ class FleetService:
             error.__cause__ = cause
         self._failed += 1
         self._failures["failed_requests"] += 1
+        self._class_stat(req.priority)["failed"] += 1
         self._handles.pop(req.rid)._fail(error)
 
     def _drop_expired(self, reqs: list, now: float) -> list:
@@ -810,11 +976,42 @@ class FleetService:
         for r in reqs:
             if r.deadline_s is not None and now >= r.deadline_s:
                 self._failures["deadline_misses"] += 1
+                self._class_stat(r.priority)["deadline_misses"] += 1
                 self._fail_request(r, DeadlineExceeded(
                     r.rid, now - r.submit_s, r.deadline_s - r.submit_s))
             else:
                 live.append(r)
         return live
+
+    def _tenant_note(self, tenant: Optional[str], delta: int) -> None:
+        """Maintain the per-tenant QUEUED count (quota admission reads
+        it O(1)); entries drop to keep the dict bounded by the live
+        tenant set."""
+        if tenant is None:
+            return
+        c = self._tenant_queued.get(tenant, 0) + delta
+        if c > 0:
+            self._tenant_queued[tenant] = c
+        else:
+            self._tenant_queued.pop(tenant, None)
+
+    # ---- per-priority-class accounting --------------------------------
+    def _class_stat(self, priority: str) -> dict:
+        return self._class_stats.setdefault(
+            priority, {"completed": 0, "failed": 0,
+                       "deadline_misses": 0})
+
+    def _note_class_terminal(self, req, latency_s: float,
+                             missed: bool) -> None:
+        """One completed (or degraded-completed) request's per-class
+        bookkeeping: its own bounded latency window + counters."""
+        self._class_lat.setdefault(
+            req.priority,
+            deque(maxlen=self._stats_window)).append(latency_s)
+        cs = self._class_stat(req.priority)
+        cs["completed"] += 1
+        if missed:
+            cs["deadline_misses"] += 1
 
     def _expire_deadlines(self, now: float) -> None:
         """Queue-side deadline expiry (pump/flush): a request that can
@@ -828,8 +1025,13 @@ class FleetService:
             q = self._queues[key]
             if not q or all(r.deadline_s is None for r in q):
                 continue
-            live = self._drop_expired(list(q), now)
+            before = list(q)
+            live = self._drop_expired(before, now)
             if len(live) != len(q):
+                kept = {r.rid for r in live}
+                for r in before:
+                    if r.rid not in kept:
+                        self._tenant_note(r.tenant, -1)
                 q.clear()
                 q.extend(live)
 
@@ -864,10 +1066,16 @@ class FleetService:
         padded = pad_configs([cfg], self._width(self.capacity), cfg)
         builds0 = run_build_count()
         if mode == "bench":
-            sim.run_bench(configs=padded, warmup=False, n_real=1)
+            res = sim.run_bench(configs=padded, warmup=False, n_real=1)
         else:
-            sim.run(configs=padded, n_real=1, warmup=False)
+            res = sim.run(configs=padded, n_real=1, warmup=False)
         self._bucket_stats[key]["builds"] += run_build_count() - builds0
+        # seed the bucket's dispatch-wall EWMA so the SLO early-flush
+        # estimate has a real number before the first live dispatch.
+        # A warm run that just compiled reports an inflated wall —
+        # which errs CONSERVATIVE (flush earlier than strictly needed)
+        # and the EWMA converges within a few live dispatches
+        self._bucket_wall.setdefault(key, float(res.wall_seconds))
 
     def stats(self) -> dict:
         """Service-level serving metrics (the BENCH json schema).
@@ -882,6 +1090,14 @@ class FleetService:
         program (zero new whole-run builds) — the compiled-program
         cache metric; the ProgramCache ``hit_rate`` below it only
         counts bucket-handle reuse.
+
+        The open-loop traffic plane (PR 7) ADDS — without changing any
+        existing aggregate field — ``latency_p99_s``, per-priority-
+        class windows under ``classes`` (each class keeps its OWN
+        bounded latency window, so sustained mixed traffic cannot
+        smear one class's tail into another's percentiles),
+        ``slo_early_flushes``, and per-tenant shed counts under
+        ``tenant_shed``.
         """
         lat = np.asarray(self._latencies, dtype=np.float64)
         occ = np.asarray([d["occupancy"] for d in self._dispatches])
@@ -891,6 +1107,8 @@ class FleetService:
         fetch = np.asarray([d["fetch_s"] for d in self._dispatches])
         host = np.asarray([d["host_s"] for d in self._dispatches])
         walls = dev + host
+        mean_pack = round(float(pack.mean()), 6) if pack.size else 0.0
+        mean_fetch = round(float(fetch.mean()), 6) if fetch.size else 0.0
         out = {
             "requests": self._next_rid,
             "completed": self._completed,
@@ -904,6 +1122,8 @@ class FleetService:
             if lat.size else 0.0,
             "latency_p95_s": round(float(np.percentile(lat, 95)), 6)
             if lat.size else 0.0,
+            "latency_p99_s": round(float(np.percentile(lat, 99)), 6)
+            if lat.size else 0.0,
             "program_hit_rate": round(hits / len(self._dispatches), 4)
             if self._dispatches else 0.0,
             # where the per-dispatch wall goes, decomposed honestly
@@ -911,16 +1131,15 @@ class FleetService:
             # (device wait, ``mean_device_wait_s`` — the mesh lever
             # moves this, and pipelining overlaps the NEXT pack under
             # it) / fetch (host transfer + unstack).  ``mean_host_s``
-            # = pack + fetch; the old key is kept for BENCH-json
-            # continuity.
-            "mean_pack_s": round(float(pack.mean()), 6)
-            if pack.size else 0.0,
+            # = pack + fetch EXACTLY as reported: it is the sum of the
+            # two rounded columns (independently rounding all three
+            # breaks the identity by up to 1.5e-6); the old key is
+            # kept for BENCH-json continuity.
+            "mean_pack_s": mean_pack,
             "mean_device_wait_s": round(float(dev.mean()), 6)
             if dev.size else 0.0,
-            "mean_fetch_s": round(float(fetch.mean()), 6)
-            if fetch.size else 0.0,
-            "mean_host_s": round(float(host.mean()), 6)
-            if host.size else 0.0,
+            "mean_fetch_s": mean_fetch,
+            "mean_host_s": round(mean_pack + mean_fetch, 6),
             "device_wait_frac": round(float(dev.sum() / walls.sum()), 4)
             if dev.size and walls.sum() > 0 else 0.0,
             "cache": self.cache.stats(),
@@ -935,7 +1154,33 @@ class FleetService:
                          for k, v in self._failures.items()},
             "breaker_open_buckets":
                 self.breaker.open_buckets(self.clock()),
+            # the SLO / traffic plane (PR 7): deadline-aware early
+            # dispatches and per-tenant admission shedding
+            "slo_early_flushes": self._early_flushes,
+            "tenant_shed": dict(sorted(self._tenant_shed.items())),
         }
+        # per-priority-class view: each class's OWN windowed
+        # percentiles + lifetime terminal counters (completed counts
+        # degraded completions; failed counts typed failures incl.
+        # queue-side deadline expiry)
+        classes = {}
+        for name in sorted(set(self._class_stats) | set(self._class_lat)):
+            cs = dict(self._class_stat(name))
+            w = np.asarray(self._class_lat.get(name, ()),
+                           dtype=np.float64)
+            terminal = cs["completed"] + cs["failed"]
+            classes[name] = {
+                **cs,
+                "deadline_miss_rate":
+                    round(cs["deadline_misses"] / terminal, 4)
+                    if terminal else 0.0,
+                "latency_p50_s": round(float(np.percentile(w, 50)), 6)
+                if w.size else 0.0,
+                "latency_p99_s": round(float(np.percentile(w, 99)), 6)
+                if w.size else 0.0,
+                "window": int(w.size),
+            }
+        out["classes"] = classes
         out["buckets"] = {repr(k): dict(v)
                           for k, v in self._bucket_stats.items()}
         return out

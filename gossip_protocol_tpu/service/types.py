@@ -34,6 +34,14 @@ class SimRequest:
     #: requests that complete late are accounted in
     #: ``RequestMetrics.deadline_missed`` — never silently dropped.
     deadline_s: Optional[float] = None
+    #: SLO priority class (service/slo.py): validated against the
+    #: service's policy when one is set (and supplying the default
+    #: deadline above), a free-form label otherwise; always feeds the
+    #: per-class stats windows
+    priority: str = "default"
+    #: tenant attribution for per-tenant admission quotas and shed
+    #: accounting (None: untenanted — never quota-limited)
+    tenant: Optional[str] = None
 
 
 @dataclass
@@ -69,6 +77,10 @@ class RequestMetrics:
     #: is still delivered; expiry BEFORE dispatch fails the handle
     #: with DeadlineExceeded instead)
     deadline_missed: bool = False
+    #: the request's SLO class and tenant, copied from the request so
+    #: per-class/per-tenant analysis needs only the metrics stream
+    priority: str = "default"
+    tenant: Optional[str] = None
 
 
 @dataclass

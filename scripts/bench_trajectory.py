@@ -4,8 +4,10 @@ The per-PR bench jsons each hold a snapshot; reading the series means
 opening five+ files and hunting for the comparable keys.  This script
 folds them into one table — headline node-ticks/s, fleet batching
 speedup, serving replay speedup (best recorded: mixed / mesh / the
-204-request curve's top row), p95 latency, device-wait fraction, and
-the chaos gate — so a regression (or a claimed win) is visible at a
+204-request curve's top row), p95 latency, device-wait fraction, the
+chaos gate, and the open-loop load columns (max achieved rps +
+measured saturation point, PR 7+; older jsons without the entry
+render "-") — so a regression (or a claimed win) is visible at a
 glance, PR over PR.
 
     PYTHONPATH=. python scripts/bench_trajectory.py          # table
@@ -85,6 +87,10 @@ def load_rows():
         replay = _best_replay(sec)
         chaos = (_get(sec, "service_replay_chaos_204req")
                  or _get(sec, "service_replay_chaos") or {})
+        # open-loop load entry (PR 7+): absent in earlier PRs' jsons —
+        # every field defaults to None and renders as "-"
+        load = sec.get("service_load_openloop") or {}
+        load_miss = _get(load, "slo_ab", "miss_rate_on")
         rows.append({
             "pr": pr,
             "backend": d.get("backend"),
@@ -98,6 +104,11 @@ def load_rows():
             "replay_source": replay[4] if replay else None,
             "chaos_completion": chaos.get("completion_rate"),
             "chaos_speedup": chaos.get("speedup_vs_sequential"),
+            "load_saturation_rps": load.get("saturation_offered_rps"),
+            "load_max_achieved_rps": load.get("max_achieved_rps"),
+            "load_miss_rate_slo_on": load_miss,
+            "load_deterministic": _get(load, "replay_check",
+                                       "deterministic"),
         })
     return rows
 
@@ -125,7 +136,9 @@ def main(argv) -> int:
             ("replay x", "replay_speedup", "{:.2f}"),
             ("p95 s", "replay_p95_s", "{:.2f}"),
             ("dev-frac", "replay_device_wait_frac", "{:.2f}"),
-            ("chaos", "chaos_completion", "{:.0%}")]
+            ("chaos", "chaos_completion", "{:.0%}"),
+            ("load rps", "load_max_achieved_rps", "{:.1f}"),
+            ("sat rps", "load_saturation_rps", "{:.1f}")]
     table = [[_fmt(r.get(key), spec) for _, key, spec in cols]
              for r in rows]
     widths = [max(len(h), *(len(t[i]) for t in table))

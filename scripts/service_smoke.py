@@ -11,6 +11,18 @@ Modes:
     python scripts/service_smoke.py mesh [34]         # replay per device count
     python scripts/service_smoke.py chaos [34] [0.12] # seeded fault sweep
     python scripts/service_smoke.py pipeline [34]     # pipelined vs sync per D
+    python scripts/service_smoke.py load [24]         # open-loop 3-seed sweep
+
+``load`` (PR 7) exercises the open-loop traffic plane
+(service/traffic.py + service/slo.py + service/loadbench.py): for
+each of three traffic seeds it replays a seeded Poisson arrival
+schedule at low / knee / saturating offered load (fractions of a
+measured closed-loop capacity probe), wall-paced through the
+pipelined scheduler with the default SLO classes.  Gates: every
+submitted handle reaches a terminal state at every load point (the
+harness raises otherwise), and each seed re-driven twice through
+VIRTUAL pacing produces the identical arrival AND outcome digests —
+load runs are replayable regression tests, like chaos runs.
 
 ``pipeline`` (PR 6) replays the acceptance stream at each D in
 {1, 2, 4, 8} TWICE — pipelined dispatch (the default) vs the
@@ -228,6 +240,48 @@ def main(argv) -> int:
               f"seed replay {'OK' if reproduced else 'FAIL'} "
               f"(schedule {m2['schedule_digest']}, "
               f"outcomes {m2['outcome_digest']})", flush=True)
+        return 0 if ok else 1
+    elif mode == "load":
+        from gossip_protocol_tpu.service.loadbench import (
+            load_catalog, measure_point, probe_capacity_rps,
+            replay_check)
+        from gossip_protocol_tpu.service.slo import default_slo
+        n_req = int(argv[1]) if len(argv) > 1 else 24
+        tpls = load_catalog(n=256, ticks=48)
+        slo = default_slo()
+        cap = probe_capacity_rps(tpls, n_requests=16)
+        print(f"open-loop sweep: capacity probe {cap:.2f} rps, "
+              f"{n_req} requests/point, classes "
+              f"{sorted(slo.classes)}", flush=True)
+        ok = True
+        for fseed in (7, 19, 23):
+            for name, frac in (("low", 0.3), ("knee", 0.75),
+                               ("saturating", 1.6)):
+                # measure_point raises on any non-terminal handle or
+                # non-deadline failure — returning IS the 100%-
+                # terminal gate
+                r = measure_point(tpls, n_req, rate_rps=cap * frac,
+                                  seed=fseed, slo=slo)
+                print(f"seed={fseed:3d} {name:10s}: offered "
+                      f"{r['offered_rps']:6.2f} rps -> achieved "
+                      f"{r['achieved_rps']:6.2f}, p50/p99 "
+                      f"{r['latency_p50_s']:.2f}/"
+                      f"{r['latency_p99_s']:.2f}s, miss rate "
+                      f"{r['deadline_miss_rate']:.2f}, occupancy "
+                      f"{r['mean_occupancy']:.2f}, early flushes "
+                      f"{r['slo_early_flushes']}, lag "
+                      f"{r['max_lag_s']:.2f}s", flush=True)
+            rc = replay_check(tpls, n_req, rate_rps=cap * 0.75,
+                              seed=fseed, slo=slo)
+            ok = ok and rc["deterministic"]
+            print(f"seed={fseed:3d} replay: arrival "
+                  f"{rc['arrival_digest']}, outcomes "
+                  f"{rc['outcome_digest']}, deterministic "
+                  f"{'OK' if rc['deterministic'] else 'FAIL'}",
+                  flush=True)
+        print(f"acceptance: 100% terminal OK (enforced), seed replay "
+              f"{'OK' if ok else 'FAIL'} (identical arrival+outcome "
+              "digests across two virtual-paced runs/seed)", flush=True)
         return 0 if ok else 1
     elif mode == "replay":
         seeds = int(argv[1]) if len(argv) > 1 else 34

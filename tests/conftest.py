@@ -52,6 +52,10 @@ def pytest_configure(config):
         "markers", "resilience: serving failure-model tests (fault "
         "injection, retry/deadline/breaker, mesh degradation; the "
         "full 204-request chaos replay is additionally marked slow)")
+    config.addinivalue_line(
+        "markers", "traffic: open-loop traffic/SLO plane tests "
+        "(seeded arrival schedules, deadline-aware early flush, "
+        "tenant quotas, virtual-clock load replay)")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,387 @@
+"""Open-loop traffic plane (PR 7): seeded arrival schedules
+(service/traffic.py), SLO-aware scheduling (service/slo.py), and the
+load harness's determinism gate (service/loadbench.py).
+
+The contracts under test:
+
+* **arrival purity** — every arrival is a pure function of
+  ``(seed, index)``: the same seed reproduces the identical schedule,
+  a longer schedule extends (never rewrites) a shorter one's prefix,
+  and the closed kind degenerates to the PR-3 replay trace exactly;
+* **deadline-aware early flush** — a partial bucket with a tight
+  deadline dispatches BEFORE ``max_wait`` when the SLO scheduler is
+  on, the identical run with it off misses the deadline, and the
+  early-flushed batch stays bit-identical to solo runs;
+* **determinism under load** — a virtual-clock traffic run (harvest
+  pinned off, wall estimate pinned) replays outcome-digest-for-digest,
+  INCLUDING with a chaos injector driving faults under the arrivals;
+* **quotas** — per-tenant admission sheds typed, never drops queued
+  work, and is invisible to other tenants.
+"""
+
+import numpy as np
+import pytest
+
+from gossip_protocol_tpu.config import SimConfig
+from gossip_protocol_tpu.core.sim import Simulation
+from gossip_protocol_tpu.service import (ClassPolicy, DeadlineExceeded,
+                                         FaultInjector, FleetService,
+                                         RetryPolicy, SLOPolicy,
+                                         Template, TenantQuotaExceeded,
+                                         TrafficPattern, VirtualClock,
+                                         build_trace, closed_schedule,
+                                         make_schedule, outcome_digest,
+                                         run_schedule)
+
+pytestmark = [pytest.mark.service, pytest.mark.traffic]
+
+
+def _dense_churn(n=16, ticks=22):
+    return SimConfig(max_nnb=n, single_failure=False, drop_msg=False,
+                     seed=0, total_ticks=ticks, fail_tick=20,
+                     rejoin_after=15)
+
+
+def _dense_drop(n=16, ticks=26):
+    return SimConfig(max_nnb=n, single_failure=True, drop_msg=True,
+                     msg_drop_prob=0.1, seed=0, total_ticks=ticks,
+                     fail_tick=10)
+
+
+def _catalog():
+    return [Template("dense-churn", _dense_churn()),
+            Template("dense-drop", _dense_drop())]
+
+
+def _slo(deadline=4.0, wall=0.3, **kw):
+    kw.setdefault("assumed_dispatch_wall_s", wall)
+    kw.setdefault("safety_factor", 1.0)
+    return SLOPolicy(classes={"interactive": ClassPolicy(deadline_s=deadline,
+                                                         weight=1.0)},
+                     default_class="interactive", **kw)
+
+
+# ---- arrival schedules are pure functions of (seed, index) ----------
+def test_schedule_pure_function_of_seed():
+    tpls = _catalog()
+    kw = dict(pattern=TrafficPattern(kind="poisson", rate_rps=6.0),
+              class_mix={"a": 0.5, "b": 0.5})
+    s1 = make_schedule(tpls, 40, seed=5, **kw)
+    s2 = make_schedule(tpls, 40, seed=5, **kw)
+    assert s1.digest() == s2.digest()
+    assert [a.t_s for a in s1.arrivals] == [a.t_s for a in s2.arrivals]
+    # a different seed draws a different schedule
+    assert make_schedule(tpls, 40, seed=6, **kw).digest() != s1.digest()
+    # the per-index draw makes a longer schedule EXTEND a shorter one:
+    # arrival i never depends on how many arrivals were asked for
+    s_short = make_schedule(tpls, 15, seed=5, **kw)
+    assert [(a.t_s, a.template.name, a.lane_seed, a.priority, a.tenant)
+            for a in s_short.arrivals] == \
+        [(a.t_s, a.template.name, a.lane_seed, a.priority, a.tenant)
+         for a in s1.arrivals[:15]]
+    # arrival times are strictly ordered and the mean gap tracks the
+    # offered rate (loosely: 40 exponential draws)
+    ts = np.asarray([a.t_s for a in s1.arrivals])
+    assert (np.diff(ts) > 0).all()
+    assert 0.4 * (40 / 6.0) < ts[-1] < 2.5 * (40 / 6.0)
+
+
+def test_arrival_kinds():
+    tpls = _catalog()
+    # burst: the on-phase of each period is denser than the off-phase
+    pat = TrafficPattern(kind="burst", rate_rps=8.0, burst_factor=3.0,
+                         duty_cycle=0.25, period_s=4.0)
+    s = make_schedule(tpls, 240, pattern=pat, seed=1)
+    ts = np.asarray([a.t_s for a in s.arrivals])
+    phase = (ts % 4.0) / 4.0
+    on = int((phase < 0.25).sum())
+    assert on > 0.45 * len(ts), (on, len(ts))   # ~0.75 expected at f=3
+    # diurnal: the middle of the period is denser than the edges, and
+    # the explicit period keeps the prefix invariant length-free
+    pat = TrafficPattern(kind="diurnal", rate_rps=8.0,
+                         diurnal_amplitude=0.75, diurnal_period_s=30.0)
+    s = make_schedule(tpls, 240, pattern=pat, seed=1)
+    ts = np.asarray([a.t_s for a in s.arrivals])
+    span = ts[-1]
+    mid = int(((ts > 0.25 * span) & (ts < 0.75 * span)).sum())
+    assert mid > 0.55 * len(ts), mid
+    s_short = make_schedule(tpls, 60, pattern=pat, seed=1)
+    assert [a.t_s for a in s_short.arrivals] == \
+        [a.t_s for a in s.arrivals[:60]]
+    # closed: every arrival at t=0
+    s = make_schedule(tpls, 10,
+                      pattern=TrafficPattern(kind="closed"), seed=1)
+    assert all(a.t_s == 0.0 for a in s.arrivals)
+    with pytest.raises(ValueError, match="unknown arrival kind"):
+        TrafficPattern(kind="pareto")
+    with pytest.raises(ValueError, match="burst_factor"):
+        TrafficPattern(kind="burst", burst_factor=10.0, duty_cycle=0.25)
+    with pytest.raises(ValueError, match="diurnal_period_s"):
+        TrafficPattern(kind="diurnal")   # length-derived default: no
+
+
+def test_closed_schedule_is_the_replay_trace():
+    """The closed-loop replay is the degenerate arrival schedule: the
+    exact (template, seed) sequence build_trace produces, at t=0."""
+    tpls = _catalog()
+    sched = closed_schedule(tpls, seeds_per_template=4)
+    trace = build_trace(tpls, 4)
+    assert [(a.template.name, a.lane_seed) for a in sched.arrivals] == \
+        [(t.name, s) for t, s in trace]
+    assert sched.span_s == 0.0
+
+
+# ---- deadline-aware early flush (satellite) --------------------------
+def test_early_flush_dispatches_before_max_wait_with_parity():
+    """A partial bucket holding a tight-deadline request dispatches
+    EARLY — before max_wait, when deadline margin <= the estimated
+    dispatch wall — and the early-flushed batch is bit-identical to
+    solo runs."""
+    cfg = _dense_churn()
+    vc = VirtualClock()
+    svc = FleetService(max_batch=8, max_wait_s=100.0, clock=vc,
+                       sleep=vc.sleep, slo=_slo(deadline=1.0, wall=0.2),
+                       pump_harvest=False)
+    hs = [svc.submit(cfg, seed=s, priority="interactive")
+          for s in (1, 2)]
+    # at submit (t=0) the margin (1.0) exceeds the estimate (0.2):
+    # NOT flushed — early flush is deadline-driven, not eager
+    assert svc.pending == 2 and svc.stats()["slo_early_flushes"] == 0
+    vc.t = 0.5
+    assert svc.pump() == 0, "flushed while the deadline still had slack"
+    vc.t = 0.85                      # margin 0.15 <= est 0.2: must go
+    assert svc.pump() == 1
+    assert svc.stats()["slo_early_flushes"] == 1
+    svc.drain()
+    sim = Simulation(cfg)
+    for s, h in zip((1, 2), hs):
+        assert h.status == "completed"
+        assert not h.metrics.deadline_missed
+        assert h.metrics.batch == 2 and h.metrics.padded_batch == 8
+        assert np.array_equal(sim.run(seed=s).sent, h.result().sent), s
+
+
+def test_without_slo_scheduling_the_same_run_misses():
+    """The identical sequence with early flush OFF: the partial bucket
+    sits past its deadline (max_wait is far away) and the requests
+    expire — the miss the SLO scheduler exists to prevent."""
+    cfg = _dense_churn()
+    vc = VirtualClock()
+    svc = FleetService(max_batch=8, max_wait_s=100.0, clock=vc,
+                       sleep=vc.sleep,
+                       slo=_slo(deadline=1.0, wall=0.2,
+                                early_flush=False),
+                       pump_harvest=False)
+    hs = [svc.submit(cfg, seed=s, priority="interactive")
+          for s in (1, 2)]
+    vc.t = 0.85
+    assert svc.pump() == 0, "early flush fired with early_flush=False"
+    vc.t = 1.1                       # past the deadline: queue expiry
+    svc.pump()
+    assert [h.status for h in hs] == ["failed", "failed"]
+    with pytest.raises(DeadlineExceeded):
+        hs[0].result()
+    st = svc.stats()
+    assert st["failures"]["deadline_misses"] == 2
+    assert st["classes"]["interactive"]["deadline_misses"] == 2
+    assert st["slo_early_flushes"] == 0
+
+
+def test_priority_class_resolution_and_default_deadline():
+    cfg = _dense_churn()
+    vc = VirtualClock()
+    slo = SLOPolicy(classes={"fast": ClassPolicy(deadline_s=5.0),
+                             "bulk": ClassPolicy(deadline_s=None)},
+                    default_class="bulk")
+    svc = FleetService(max_batch=8, clock=vc, sleep=vc.sleep, slo=slo,
+                       pump_harvest=False)
+    h_fast = svc.submit(cfg, seed=1, priority="fast")
+    h_bulk = svc.submit(cfg, seed=2)          # defaults to bulk
+    assert h_fast.request.deadline_s == 5.0
+    assert h_bulk.request.deadline_s is None
+    assert h_bulk.request.priority == "bulk"
+    # the policy OWNS deadlines: a deadline-less class stays
+    # deadline-less even when the service carries a global default
+    svc_dflt = FleetService(max_batch=8, clock=vc, sleep=vc.sleep,
+                            slo=slo, default_deadline_s=5.0,
+                            pump_harvest=False)
+    assert svc_dflt.submit(cfg, seed=9).request.deadline_s is None
+    svc_dflt.drain()
+    with pytest.raises(ValueError, match="unknown priority class"):
+        svc.submit(cfg, seed=3, priority="warp")
+    # an explicit deadline overrides the class default
+    h = svc.submit(cfg, seed=4, priority="fast", deadline_s=1.5)
+    assert h.request.deadline_s == pytest.approx(vc.t + 1.5)
+    svc.drain()
+
+
+# ---- per-class stats windows (satellite) -----------------------------
+def test_stats_split_per_priority_class():
+    """stats() reports p50/p99 per priority class from per-class
+    windows, without changing the existing aggregate fields."""
+    cfg = _dense_churn()
+    svc = FleetService(max_batch=2)
+    [svc.submit(cfg, seed=s, priority="gold") for s in (1, 2)]
+    [svc.submit(cfg, seed=s, priority="dirt") for s in (3, 4)]
+    svc.drain()
+    st = svc.stats()
+    assert set(st["classes"]) == {"gold", "dirt"}
+    for name in ("gold", "dirt"):
+        c = st["classes"][name]
+        assert c["completed"] == 2 and c["window"] == 2
+        assert c["latency_p50_s"] > 0.0
+        assert c["latency_p99_s"] >= c["latency_p50_s"]
+        assert c["deadline_miss_rate"] == 0.0
+    # the aggregate fields are still there, untouched in meaning
+    for k in ("latency_p50_s", "latency_p95_s", "mean_occupancy",
+              "program_hit_rate", "device_wait_frac"):
+        assert k in st
+    assert st["latency_p99_s"] >= st["latency_p50_s"]
+
+
+# ---- tenant quotas (tentpole) ----------------------------------------
+def test_tenant_quota_sheds_typed_and_isolated():
+    cfg = _dense_churn()
+    svc = FleetService(max_batch=8, tenant_quota=2)
+    h1 = svc.submit(cfg, seed=1, tenant="acme")
+    h2 = svc.submit(cfg, seed=2, tenant="acme")
+    with pytest.raises(TenantQuotaExceeded, match="tenant 'acme'"):
+        svc.submit(cfg, seed=3, tenant="acme")
+    # another tenant (and untenanted traffic) is unaffected
+    h3 = svc.submit(cfg, seed=4, tenant="globex")
+    h4 = svc.submit(cfg, seed=5)
+    st = svc.stats()
+    assert st["failures"]["shed"] == 1
+    assert st["tenant_shed"] == {"acme": 1}
+    svc.drain()                   # nothing queued was dropped
+    assert all(h.status == "completed" for h in (h1, h2, h3, h4))
+    assert h1.metrics.tenant == "acme"
+    assert svc._tenant_queued == {}, "queued-count drifted after drain"
+    # room again after the drain
+    assert svc.submit(cfg, seed=6, tenant="acme").request.tenant == "acme"
+    svc.drain()
+    with pytest.raises(ValueError, match="tenant_quota"):
+        FleetService(tenant_quota=0)
+
+
+# ---- deterministic virtual-clock load runs ---------------------------
+def _virtual_run(sched, injector_seed=None, fault_rate=0.0):
+    vc = VirtualClock()
+    inj = FaultInjector(seed=injector_seed, fault_rate=fault_rate) \
+        if injector_seed is not None else None
+    svc = FleetService(
+        max_batch=4, max_wait_s=2.0, clock=vc, sleep=vc.sleep,
+        slo=_slo(deadline=6.0, wall=0.25), pump_harvest=False,
+        injector=inj,
+        retry=RetryPolicy(max_retries=2, backoff_base_s=1e-3))
+    handles, rec = run_schedule(svc, sched, pace="virtual", clock=vc)
+    dig = outcome_digest(sched, handles, rec["sheds"])
+    fault_dig = inj.schedule_digest() if inj is not None else None
+    return handles, dig, fault_dig
+
+
+def test_virtual_load_run_replays_digest_for_digest():
+    tpls = _catalog()
+    sched = make_schedule(tpls, 14,
+                          TrafficPattern(kind="burst", rate_rps=6.0),
+                          seed=9, class_mix={"interactive": 1.0})
+    h1, d1, _ = _virtual_run(sched)
+    h2, d2, _ = _virtual_run(sched)
+    assert d1 == d2
+    assert all(h.done for h in h1)
+    # and the served lanes are bit-identical to solo runs
+    a = sched.arrivals[0]
+    ref = Simulation(a.template.cfg).run(seed=a.lane_seed)
+    assert np.array_equal(h1[0].result().sent, ref.sent)
+
+
+def test_chaos_seed_replays_under_load_generator():
+    """Satellite regression: a chaos seed stays digest-for-digest
+    replayable while the load generator drives arrivals — the idle
+    harvest is off (injector active AND pump_harvest=False), the
+    traffic clock advances purely per the schedule, and fault draws
+    sit at fixed points of the submit/flush sequence."""
+    tpls = _catalog()
+    sched = make_schedule(tpls, 14,
+                          TrafficPattern(kind="poisson", rate_rps=6.0),
+                          seed=9, class_mix={"interactive": 1.0})
+    h1, d1, f1 = _virtual_run(sched, injector_seed=11, fault_rate=0.3)
+    h2, d2, f2 = _virtual_run(sched, injector_seed=11, fault_rate=0.3)
+    assert f1 == f2, "fault schedule diverged under the load generator"
+    assert d1 == d2, "outcomes diverged under the load generator"
+    assert all(h.done for h in h1)
+    # the schedule must actually have injected something for the test
+    # to mean anything
+    assert f1 is not None
+    # a different chaos seed still terminates everything (validity is
+    # seed-independent; only the schedule changes)
+    h3, _, f3 = _virtual_run(sched, injector_seed=12, fault_rate=0.3)
+    assert all(h.done for h in h3)
+    assert all(h.done for h in h2)
+
+
+def test_virtual_pacing_guards():
+    """Virtual pacing refuses wall-dependent setups loudly: a service
+    on a real clock, or one whose idle harvest is still enabled."""
+    tpls = _catalog()
+    sched = make_schedule(tpls, 3, seed=1)
+    svc = FleetService(max_batch=4)
+    with pytest.raises(ValueError, match="VirtualClock"):
+        run_schedule(svc, sched, pace="virtual")
+    vc = VirtualClock()
+    svc = FleetService(max_batch=4, clock=vc, sleep=vc.sleep)
+    with pytest.raises(ValueError, match="pump_harvest"):
+        run_schedule(svc, sched, pace="virtual", clock=vc)
+    with pytest.raises(ValueError, match="unknown pace"):
+        run_schedule(svc, sched, pace="warp")
+    svc.drain()
+    # an UNPINNED early-flush wall estimate is wall-dependent too:
+    # virtual pacing refuses it unless the policy pins the estimate
+    # (or early flush is off)
+    vc = VirtualClock()
+    svc = FleetService(max_batch=4, clock=vc, sleep=vc.sleep,
+                       pump_harvest=False,
+                       slo=_slo(deadline=5.0, wall=None))
+    with pytest.raises(ValueError, match="assumed_dispatch_wall_s"):
+        run_schedule(svc, sched, pace="virtual", clock=vc)
+    svc.drain()
+
+
+def test_pump_harvest_false_pins_idle_harvest_off():
+    """pump_harvest=False: an idle pump never resolves the in-flight
+    batch (the wall-dependent readiness poll is off); flush still
+    does."""
+    import time as _time
+    cfg = _dense_churn()
+    svc = FleetService(max_batch=2, pipeline=True, pump_harvest=False)
+    hs = [svc.submit(cfg, seed=s) for s in (1, 2)]
+    assert svc.in_flight == 2
+    deadline = _time.perf_counter() + 2.0
+    while _time.perf_counter() < deadline and \
+            not svc._inflight.pending.is_ready():
+        _time.sleep(0.01)
+    assert svc.pump() == 0
+    assert svc.in_flight == 2, "idle pump harvested with harvest off"
+    svc.flush()
+    assert all(h.status == "completed" for h in hs)
+
+
+# ---- wall pacing + the loadbench determinism gate --------------------
+def test_wall_paced_open_loop_run_terminates():
+    tpls = _catalog()
+    sched = make_schedule(tpls, 6,
+                          TrafficPattern(kind="poisson", rate_rps=50.0),
+                          seed=2)
+    svc = FleetService(max_batch=4)
+    handles, rec = run_schedule(svc, sched, pace="wall")
+    assert all(h is not None and h.done for h in handles)
+    assert rec["wall_s"] > 0.0 and rec["sheds"] == []
+    assert rec["max_lag_s"] >= 0.0
+
+
+def test_loadbench_replay_check_deterministic():
+    from gossip_protocol_tpu.service.loadbench import replay_check
+    rc = replay_check(_catalog(), n_requests=8, rate_rps=6.0, seed=4,
+                      slo=_slo(deadline=6.0, wall=0.25))
+    assert rc["deterministic"], rc
+    assert rc["runs"] == 2 and len(rc["arrival_digest"]) == 16
