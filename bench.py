@@ -598,6 +598,57 @@ def main():
             "parity_checked": ch["parity_checked"],
             "env": _env_provenance(),
         }
+        # elastic serving (PR 8, docs/SERVING.md "Elastic capacity"):
+        # the same stream served as RESUMABLE LEGS (segment-boundary
+        # checkpoints) under ONE seeded device loss + ONE device
+        # return.  elastic_replay raises unless 100% completion, >= 1
+        # loss AND >= 1 return injected, ZERO lanes restarted from
+        # tick 0 (every interrupted lane resumes from its last
+        # checkpoint), per-request parity, and — on a mesh — lane
+        # migration across the shrink -> grow rebuild; this entry
+        # existing IS the gate.  Served from a 2-device lane mesh when
+        # virtual devices are live, so the loss+return exercises the
+        # real grow ladder (and the program cache's re-key path).
+        from gossip_protocol_tpu.service import elastic_replay
+        el_d = 2 if (jax.device_count() > 1 and sv_lanes % 2 == 0) \
+            else 1
+        el_mesh = None
+        if el_d > 1:
+            from gossip_protocol_tpu.parallel.fleet_mesh import \
+                make_lane_mesh as _mk_mesh_el
+            el_mesh = _mk_mesh_el(el_d)
+        el = elastic_replay(sv_templates, seeds_per_template=seeds_sv,
+                            max_batch=sv_lanes // el_d, mesh=el_mesh,
+                            checkpoint_every=48,
+                            fault_seed=20260804, sequential=seq_leg)
+        secondary["service_replay_elastic"] = {
+            "fault_seed": el["fault_seed"],
+            "checkpoint_every": el["checkpoint_every"],
+            "device_loss_at": el["device_loss_at"],
+            "device_return_at": el["device_return_at"],
+            "requests": el["requests"],
+            "completion_rate": el["completion_rate"],
+            "stranded": el["stranded"],
+            "restarted_from_zero": el["restarted_from_zero"],
+            "degraded_requests": el["degraded_requests"],
+            "faults": el["faults"],
+            "elastic": el["elastic"],
+            "mean_legs": el["mean_legs"],
+            "cache_rekey_hits": el["cache_rekey_hits"],
+            "retries": el["failures"]["retries"],
+            "device_losses": el["failures"]["device_losses"],
+            "device_returns": el["failures"]["device_returns"],
+            "mesh_rebuilds": el["failures"]["mesh_rebuilds"],
+            "devices_start": el["devices_start"],
+            "devices_end": el["devices_end"],
+            "latency_p50_s": el["latency_p50_s"],
+            "latency_p95_s": el["latency_p95_s"],
+            "speedup_vs_sequential": el["speedup_vs_sequential"],
+            "schedule_digest": el["schedule_digest"],
+            "outcome_digest": el["outcome_digest"],
+            "parity_checked": el["parity_checked"],
+            "env": _env_provenance(),
+        }
         if jax.device_count() > 1:
             # lane-mesh serving (parallel/fleet_mesh.py) at EQUAL total
             # lane width: max_batch is per-device and d must DIVIDE

@@ -368,6 +368,155 @@ class FleetResult:
         return self.aggregate_node_ticks_per_second / max(self.batch, 1)
 
 
+@dataclass
+class LaneCheckpoint:
+    """One lane's resumable snapshot at a segment boundary.
+
+    Everything here is HOST numpy: the lane's carry (``state``, the
+    per-lane view of the stacked scan carry, shared clock excluded),
+    the absolute clock of the snapshot (``tick`` — the plan position:
+    the PR-1 segment planner's cuts are the only legal values,
+    models/segments.checkpoint_ticks), and the per-leg outputs
+    accumulated so far (``chunks``).  A checkpoint is therefore
+    mesh-independent by construction — it can re-enter a fleet of any
+    width on any mesh (the serving layer migrates checkpointed lanes
+    across a mesh rebuild this way), and :func:`finish_lane` assembles
+    the final per-lane result bit-identical to an uninterrupted run
+    once the clock reaches ``total_ticks``.
+
+    ``chunks`` format: overlay lanes accumulate per-leg
+    ``OverlayMetrics`` structs (numpy leaves, each ``[leg_ticks]``);
+    dense trace lanes accumulate ``(added, removed, sent, recv)``
+    tuples (``added/removed`` ``[leg_ticks, N, N]``, counters
+    ``[leg_ticks, N]``).
+    """
+
+    cfg: SimConfig
+    mode: str                 # "trace" | "bench" (overlay: both run
+    #                           the metrics path; dense bench-mode
+    #                           runs cannot be checkpointed)
+    tick: int                 # absolute clock of the carry
+    state: dict               # {field: np.ndarray}, lane view, no tick
+    chunks: list              # accumulated per-leg host outputs
+    wall_seconds: float = 0.0  # accumulated across this lane's legs
+    legs: int = 0             # legs executed so far
+    #: mesh descriptor of the dispatch that produced this snapshot —
+    #: the serving layer compares it against the current mesh to count
+    #: lane migrations (a checkpoint itself is mesh-independent)
+    mesh_desc: object = None
+
+    @property
+    def done(self) -> bool:
+        return self.tick >= self.cfg.total_ticks
+
+    def digest(self) -> str:
+        """Stable short hash of the snapshot (clock + carry bytes)."""
+        import hashlib
+        h = hashlib.sha256()
+        h.update(repr((self.tick, self.cfg.seed, self.mode)).encode())
+        for name in sorted(self.state):
+            h.update(name.encode())
+            h.update(np.ascontiguousarray(self.state[name]).tobytes())
+        return h.hexdigest()[:16]
+
+
+def finish_lane(ck: LaneCheckpoint):
+    """Assemble a completed lane's final result from its checkpoint:
+    the accumulated chunks stitched over the full horizon plus the
+    final carry — bit-identical to the lane of an uninterrupted fleet
+    run (tests/test_elastic.py).  Pure host work (no device ops): the
+    serving layer calls this on the resolve path, where a device op
+    could queue behind the next in-flight program."""
+    if not ck.done:
+        raise ValueError(
+            f"lane at tick {ck.tick} of {ck.cfg.total_ticks} is not "
+            "finished; resume it before assembling a result")
+    if ck.cfg.model == "overlay":
+        from ..models.overlay import (OverlayResult, OverlayState,
+                                      make_overlay_schedule)
+        metrics = jax.tree.map(
+            lambda *xs: np.concatenate([np.asarray(x) for x in xs]),
+            *ck.chunks)
+        final = OverlayState(tick=np.int32(ck.tick),
+                             **{k: v for k, v in ck.state.items()})
+        return OverlayResult(cfg=ck.cfg,
+                             sched=make_overlay_schedule(ck.cfg),
+                             final_state=final, metrics=metrics,
+                             wall_seconds=ck.wall_seconds)
+    sched = make_schedule_host(ck.cfg)
+    added = np.concatenate([c[0] for c in ck.chunks], 0)
+    removed = np.concatenate([c[1] for c in ck.chunks], 0)
+    sent = np.concatenate([c[2] for c in ck.chunks], 0).T.copy()
+    recv = np.concatenate([c[3] for c in ck.chunks], 0).T.copy()
+    final = WorldState(tick=np.int32(ck.tick),
+                       **{k: v for k, v in ck.state.items()})
+    return SimResult(
+        cfg=ck.cfg,
+        start_tick=np.asarray(sched.start_tick),
+        fail_tick=np.asarray(sched.fail_tick),
+        rejoin_tick=np.asarray(sched.rejoin_tick),
+        added=added, removed=removed, sent=sent, recv=recv,
+        final_state=final, wall_seconds=ck.wall_seconds)
+
+
+@dataclass
+class FleetLeg:
+    """One resolved leg of a checkpointed fleet dispatch: every real
+    lane advanced to the leg's end cut, snapshotted host-side.
+
+    ``lanes`` aliases ``checkpoints`` so the serving layer's
+    per-lane machinery (fault-plane poisoning, count validation)
+    treats a leg like any other resolved dispatch.  Timing fields
+    describe THIS leg; each checkpoint's ``wall_seconds`` carries the
+    lane's accumulated total."""
+
+    checkpoints: list
+    start: int
+    ticks: int
+    wall_seconds: float
+    pack_seconds: float
+    device_seconds: float
+    fetch_seconds: float
+    padded_batch: int
+
+    @property
+    def lanes(self) -> list:
+        return self.checkpoints
+
+    @property
+    def batch(self) -> int:
+        return len(self.checkpoints)
+
+    @property
+    def occupancy(self) -> float:
+        width = self.padded_batch or self.batch
+        return self.batch / width if width else 0.0
+
+    @property
+    def done(self) -> bool:
+        return all(ck.done for ck in self.checkpoints)
+
+    def results(self) -> FleetResult:
+        """The final :class:`FleetResult` (``done`` legs only):
+        per-lane results assembled from the accumulated chunks.
+        ``wall_seconds`` is the ACCUMULATED fleet wall across every
+        leg; the pack/execute/fetch decomposition is the final leg's
+        (the per-leg columns were already reported per dispatch)."""
+        lanes = [finish_lane(ck) for ck in self.checkpoints]
+        _check_unstacked(lanes, len(self.checkpoints))
+        wall = self.checkpoints[0].wall_seconds if self.checkpoints \
+            else self.wall_seconds
+        for lane in lanes:
+            lane.wall_seconds = wall
+        return FleetResult(
+            lanes=lanes, wall_seconds=wall,
+            padded_batch=self.padded_batch
+            if len(self.checkpoints) < (self.padded_batch or 0) else 0,
+            device_seconds=self.device_seconds,
+            pack_seconds=self.pack_seconds,
+            fetch_seconds=self.fetch_seconds)
+
+
 class PendingFleet:
     """An in-flight fleet dispatch: the device program is launched
     (async), the results are not yet fetched.
@@ -1078,12 +1227,342 @@ class FleetSimulation:
                              fetch_seconds=fetch)
         return PendingFleet(lambda: result, pack)
 
-    def _overlay_fleet_fn(self, batch: int):
+    # ---- checkpoint / resume legs (PR 8: elastic serving) ------------
+    def _leg_state_fields(self, cls) -> list:
+        return [f.name for f in dataclasses.fields(cls)
+                if f.name != "tick"]
+
+    def _snapshot_lane(self, final_h, i: int, cls) -> dict:
+        """Host numpy view of lane ``i``'s carry (shared clock
+        excluded; the LaneCheckpoint's ``tick`` is authoritative)."""
+        return {name: np.asarray(getattr(final_h, name))[i]
+                for name in self._leg_state_fields(cls)}
+
+    def _resume_states(self, cks: list, cls, tick: int):
+        """Re-stack per-lane host snapshots into the scan carry: a
+        stacked numpy tree with the SHARED scalar clock — it enters
+        the jitted leg program as ordinary call inputs (the mesh run
+        wrapper places it with the canonical shardings)."""
+        stacked = {name: np.stack([ck.state[name] for ck in cks])
+                   for name in self._leg_state_fields(cls)}
+        return cls(tick=np.int32(tick), **stacked)
+
+    def _advance_checkpoints(self, cks, cfgs, mode: str, end: int,
+                             nr: int, snap, chunk_of,
+                             wall: float) -> list:
+        """Build the leg's output checkpoints: lane ``i``'s new carry
+        snapshot + its accumulated chunks (a fresh list per leg — a
+        retried leg rebuilds from the PREVIOUS checkpoint, whose chunk
+        list must stay untouched)."""
+        out = []
+        for i in range(nr):
+            prev = cks[i] if cks is not None else None
+            out.append(LaneCheckpoint(
+                cfg=cfgs[i], mode=mode, tick=end, state=snap(i),
+                chunks=(list(prev.chunks) if prev is not None else [])
+                + [chunk_of(i)],
+                wall_seconds=(prev.wall_seconds if prev is not None
+                              else 0.0) + wall,
+                legs=(prev.legs if prev is not None else 0) + 1,
+                mesh_desc=self._mesh_entry()))
+        return out
+
+    def run_leg(self, seeds=None, configs=None, resume=None,
+                ticks=None, n_real=None, width=None,
+                mode: str = "trace") -> FleetLeg:
+        """:meth:`launch_leg` + resolve."""
+        return self.launch_leg(seeds=seeds, configs=configs,
+                               resume=resume, ticks=ticks,
+                               n_real=n_real, width=width,
+                               mode=mode).resolve()
+
+    def launch_leg(self, seeds=None, configs=None, resume=None,
+                   ticks=None, n_real=None, width=None,
+                   mode: str = "trace", defer: bool = False
+                   ) -> PendingFleet:
+        """Launch one resumable LEG of a fleet run: ``ticks`` ticks of
+        the scan, starting from tick 0 (``seeds=``/``configs=``, the
+        ordinary staged init) or from a batch of
+        :class:`LaneCheckpoint` snapshots (``resume=``).  The
+        resolved :class:`PendingFleet` yields a :class:`FleetLeg`
+        whose checkpoints re-enter this method until ``done``, at
+        which point :meth:`FleetLeg.results` assembles per-lane
+        results BIT-IDENTICAL to an uninterrupted run — the schedule
+        is closed-form in the absolute clock carried in the scan
+        state, so a shorter scan resumes mid-run exactly
+        (tests/test_elastic.py).
+
+        Snapshot discipline: leg boundaries must land on the PR-1
+        segment planner's cuts (models/segments.checkpoint_ticks) —
+        or the run's end — so the grid path's phase elision stays
+        static across a resume (docs/PERF.md §7).  Resumed lanes must
+        agree on the clock (a fleet shares ONE unbatched scan clock)
+        and are padded to ``width`` by replicating lane 0's snapshot
+        (filler lanes are data-independent and masked out, so any
+        well-shaped carry is inert).
+
+        Supported paths: every overlay request, and dense ``trace``
+        mode.  Dense ``bench`` mode compiles the active-corner width
+        into its whole-run program and is served monolithically
+        (service/scheduler.py leaves it un-checkpointed).
+        """
+        from ..models.segments import checkpoint_ticks
+        if resume is None:
+            cfgs = self._lane_cfgs(seeds, configs)
+            nr = self._resolve_n_real(len(cfgs), n_real)
+            cks = None
+            start = 0
+        else:
+            if seeds is not None or configs is not None:
+                raise ValueError(
+                    "pass resume= alone (the checkpoints carry their "
+                    "own configs)")
+            cks = list(resume)
+            if not cks:
+                raise ValueError("empty resume batch")
+            t0s = {ck.tick for ck in cks}
+            if len(t0s) != 1:
+                raise ValueError(
+                    f"resumed lanes disagree on the clock "
+                    f"{sorted(t0s)}; a fleet shares ONE scan clock — "
+                    "batch same-tick checkpoints only")
+            modes = {ck.mode for ck in cks}
+            if len(modes) != 1:
+                raise ValueError(f"resumed lanes mix modes {modes}")
+            mode = modes.pop()
+            start = t0s.pop()
+            nr = len(cks)
+            w = nr if width is None else int(width)
+            if w < nr:
+                raise ValueError(f"width={w} < {nr} resumed lanes")
+            cks_p = cks + [cks[0]] * (w - nr)
+            cfgs = [ck.cfg for ck in cks_p]
+            self._lane_cfgs(None, cfgs)     # shape (+ mesh) validation
+        total = self.cfg.total_ticks
+        length = (total - start) if ticks is None else int(ticks)
+        end = start + length
+        if length < 1 or end > total:
+            raise ValueError(
+                f"leg [{start}, {end}) outside the run's "
+                f"[0, {total}] horizon")
+        cuts = set(checkpoint_ticks(self.cfg))
+        if start != 0 and start not in cuts:
+            raise ValueError(
+                f"leg start {start} is not a segment cut "
+                f"{sorted(cuts)}; segment boundaries are the only "
+                "legal snapshot points (models/segments.py)")
+        if end != total and end not in cuts:
+            raise ValueError(
+                f"leg end {end} is not a segment cut {sorted(cuts)} "
+                "or the run's end; segment boundaries are the only "
+                "legal snapshot points (models/segments.py)")
+        if self.cfg.model == "overlay":
+            return self._overlay_leg_launch(cfgs, cks, mode, start,
+                                            length, nr, defer)
+        if mode != "trace":
+            raise NotImplementedError(
+                "dense bench-mode runs compile their active-corner "
+                "width whole-run and cannot be checkpointed; serve "
+                "them monolithically")
+        return self._dense_trace_leg_launch(cfgs, cks, start, length,
+                                            nr, defer)
+
+    def _overlay_leg_launch(self, cfgs, cks, mode: str, start: int,
+                            length: int, nr: int,
+                            defer: bool) -> PendingFleet:
+        from ..models.overlay import OverlayState, make_overlay_schedule
+        b = len(cfgs)
+        end = start + length
+        run = self._overlay_fleet_fn(b, length=length, start_tick=start)
+        t0 = time.perf_counter()
+        scheds = [make_overlay_schedule(c) for c in cfgs]
+        sscheds = stack_lanes_host(scheds)
+        if cks is None:
+            states0 = self._overlay_init_stacked(b)()
+        else:
+            cks_p = cks + [cks[0]] * (b - nr)
+            states0 = self._resume_states(cks_p, OverlayState, start)
+        stage_s = time.perf_counter() - t0
+        box: dict = {}
+
+        def start_fn():
+            t_s0 = time.perf_counter()
+            final, metrics = run(states0, sscheds)
+            box["out"] = (final, metrics if nr == b else
+                          jax.tree.map(lambda m: m[:nr], metrics))
+            box["held"] = _pop_held(run)
+            box["t_launch"] = time.perf_counter()
+            box["pack"] = stage_s + (box["t_launch"] - t_s0)
+
+        def wait():
+            if "t_ready" not in box:
+                jax.block_until_ready(box["out"][0].ids)
+                box["t_ready"] = time.perf_counter()
+
+        def probe():
+            return "t_ready" in box or bool(box["out"][0].ids.is_ready())
+
+        def resolve():
+            final, mets = box["out"]
+            execute = box["t_ready"] - box["t_launch"]
+            pack = box["pack"]
+            t_f0 = time.perf_counter()
+            metrics_h = jax.device_get(mets)
+            final_h = jax.device_get(final)
+            if int(final_h.tick) != end:
+                raise RuntimeError(
+                    f"fleet leg stopped at tick {int(final_h.tick)}, "
+                    f"expected {end}")
+            fetch = time.perf_counter() - t_f0
+            wall = pack + execute + fetch
+            new = self._advance_checkpoints(
+                cks, cfgs, mode, end, nr,
+                snap=lambda i: self._snapshot_lane(final_h, i,
+                                                   OverlayState),
+                chunk_of=lambda i: jax.tree.map(
+                    lambda m, _i=i: np.asarray(m)[_i], metrics_h),
+                wall=wall)
+            return FleetLeg(checkpoints=new, start=start, ticks=length,
+                            wall_seconds=wall, pack_seconds=pack,
+                            device_seconds=execute, fetch_seconds=fetch,
+                            padded_batch=b)
+
+        pending = PendingFleet(resolve, stage_s,
+                               hold=(states0, sscheds, box),
+                               start_fn=start_fn, wait_fn=wait,
+                               probe_fn=probe)
+        if not defer:
+            pending.start()
+        return pending
+
+    def _dense_trace_leg_launch(self, cfgs, cks, start: int,
+                                length: int, nr: int,
+                                defer: bool) -> PendingFleet:
+        b = len(cfgs)
+        n = self.cfg.n
+        end = start + length
+        shared = _shared_drop(cfgs)
+        t0 = time.perf_counter()
+        scheds = [make_schedule_host(c) for c in cfgs]
+        sscheds = self._stack_scheds_dev(scheds, shared)
+        if cks is None:
+            init = self._dense_init_stacked(self.cfg, b)
+            seeds_v = np.asarray([c.seed for c in cfgs], np.int64)
+            states0 = init(seeds_v)
+        else:
+            cks_p = cks + [cks[0]] * (b - nr)
+            states0 = self._resume_states(cks_p, WorldState, start)
+        chunk = self.chunk_ticks
+        if chunk is None:
+            per_tick = 2 * n * n * b
+            chunk = max(1, min(length, (1 << 30) // max(per_tick, 1)))
+
+        def _leg(new_cks, pack, execute, fetch) -> FleetLeg:
+            return FleetLeg(checkpoints=new_cks, start=start,
+                            ticks=length,
+                            wall_seconds=pack + execute + fetch,
+                            pack_seconds=pack, device_seconds=execute,
+                            fetch_seconds=fetch, padded_batch=b)
+
+        def _snap_and_chunks(final_h, chunks, pack, execute, fetch):
+            if int(final_h.tick) != end:
+                raise RuntimeError(
+                    f"fleet leg stopped at tick {int(final_h.tick)}, "
+                    f"expected {end}")
+            a_all = np.concatenate([c[0] for c in chunks], 0)
+            r_all = np.concatenate([c[1] for c in chunks], 0)
+            s_all = np.concatenate([c[2] for c in chunks], 0)
+            r2_all = np.concatenate([c[3] for c in chunks], 0)
+            wall = pack + execute + fetch
+            return self._advance_checkpoints(
+                cks, cfgs, "trace", end, nr,
+                snap=lambda i: self._snapshot_lane(final_h, i,
+                                                   WorldState),
+                chunk_of=lambda i: (a_all[:, i], r_all[:, i],
+                                    s_all[:, i], r2_all[:, i]),
+                wall=wall)
+
+        if chunk >= length:
+            run = self._dense_trace_fn(b, length, shared)
+            stage_s = time.perf_counter() - t0
+            box: dict = {}
+
+            def start_fn():
+                t_s0 = time.perf_counter()
+                states, ev = run(states0, sscheds)
+                box["out"] = (states,
+                              self._dense_trace_stage_device(ev, length,
+                                                             nr))
+                box["held"] = _pop_held(run)
+                box["t_launch"] = time.perf_counter()
+                box["pack"] = stage_s + (box["t_launch"] - t_s0)
+
+            def wait():
+                if "t_ready" not in box:
+                    jax.block_until_ready(box["out"][0].tick)
+                    box["t_ready"] = time.perf_counter()
+
+            def probe():
+                return "t_ready" in box \
+                    or bool(box["out"][0].tick.is_ready())
+
+            def resolve():
+                states, staged = box["out"]
+                pack = box["pack"]
+                execute = box["t_ready"] - box["t_launch"]
+                t_f0 = time.perf_counter()
+                a_h, r_h, s_h, r2_h = \
+                    self._dense_trace_finish_host(staged, nr)
+                final_h = jax.device_get(states)
+                fetch = time.perf_counter() - t_f0
+                return _leg(_snap_and_chunks(
+                    final_h, [(a_h, r_h, s_h, r2_h)], pack, execute,
+                    fetch), pack, execute, fetch)
+
+            pending = PendingFleet(resolve, stage_s,
+                                   hold=(states0, sscheds, box),
+                                   start_fn=start_fn, wait_fn=wait,
+                                   probe_fn=probe)
+            if not defer:
+                pending.start()
+            return pending
+        # a leg bigger than the device event budget runs the chunked
+        # transfer loop eagerly — itself a host-device pipeline — and
+        # hands back a pre-resolved PendingFleet (same contract as the
+        # multi-chunk launch(): ``started`` is True, so the pipelined
+        # scheduler falls back to the synchronous beat)
+        pack = time.perf_counter() - t0
+        chunks = []
+        t_dev = 0.0
+        states = states0
+        done = 0
+        while done < length:
+            ln = min(chunk, length - done)
+            run = self._dense_trace_fn(b, ln, shared)
+            t_dev0 = time.perf_counter()
+            states, ev = run(states, sscheds)
+            jax.block_until_ready(states.tick)
+            t_dev += time.perf_counter() - t_dev0
+            chunks.append(self._dense_trace_finish_host(
+                self._dense_trace_stage_device(ev, ln, nr), nr))
+            done += ln
+        final_h = jax.device_get(states)
+        wall = time.perf_counter() - t0
+        fetch = max(0.0, wall - pack - t_dev)
+        leg = _leg(_snap_and_chunks(final_h, chunks, pack, t_dev,
+                                    fetch), pack, t_dev, fetch)
+        return PendingFleet(lambda: leg, pack)
+
+    def _overlay_fleet_fn(self, batch: int, length: Optional[int] = None,
+                          start_tick: int = 0):
         """The overlay fleet's compiled program (the mesh subclass in
         parallel/fleet_mesh.py overrides this with the lane-sharded
-        build)."""
+        build).  ``length``/``start_tick`` scan a leg of the run from
+        a pinned clock (checkpoint/resume, :meth:`launch_leg`; the
+        start tick shapes only the TPU grid path's segment plan)."""
         from ..models.overlay import make_overlay_fleet_run
-        return make_overlay_fleet_run(self.cfg, batch)
+        return make_overlay_fleet_run(self.cfg, batch, length=length,
+                                      start_tick=start_tick)
 
     # ---- overlay (metrics mode) --------------------------------------
     def _overlay_launch(self, cfgs: Sequence[SimConfig], warmup: bool,

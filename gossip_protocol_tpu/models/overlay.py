@@ -1064,7 +1064,8 @@ OVERLAY_FLEET_STATE_AXES = OverlayState(tick=None, ids=0, hb=0, ts=0,
 
 def make_overlay_fleet_run(cfg: SimConfig, batch: int,
                            length: int | None = None,
-                           use_pallas: bool | None = None):
+                           use_pallas: bool | None = None,
+                           start_tick: int = 0):
     """One compiled program over ``batch`` stacked overlay lanes.
 
     ``run(states, scheds) -> (finals, OverlayMetrics[batch, length])``:
@@ -1091,6 +1092,14 @@ def make_overlay_fleet_run(cfg: SimConfig, batch: int,
     Per lane the trajectory is bit-identical to a sequential
     :func:`make_overlay_run` of the lane's schedule
     (tests/test_fleet.py); only the ``live_uncovered`` metric differs.
+
+    ``start_tick`` pins the absolute clock the scan starts at — the
+    checkpoint/resume leg path (core/fleet.py ``launch_leg``) passes
+    its cut so the GRID path plans (and clock-guards) the segment-
+    specialized kernels from the right tick; leg boundaries are
+    segment cuts, so the resumed plan is the tick-0 plan's tail and
+    phase elision stays static.  The XLA vmap path reads the clock
+    from the carried state and ignores it (any start is exact there).
     """
     length = cfg.total_ticks if length is None else length
     if use_pallas is None:
@@ -1098,7 +1107,11 @@ def make_overlay_fleet_run(cfg: SimConfig, batch: int,
     from .overlay_grid import grid_supported, make_grid_fleet_run
     grid = (bool(use_pallas) and grid_supported(cfg)
             and jax.default_backend() == "tpu")
-    key = (cfg.replace(seed=0), batch, length, grid)
+    # start_tick only shapes the grid build (segment plan + clock
+    # guard); keying it unconditionally would mint redundant XLA-path
+    # entries for every cut
+    key = (cfg.replace(seed=0), batch, length, grid,
+           start_tick if grid else 0)
     if key in _OVERLAY_FLEET_CACHE:
         return _OVERLAY_FLEET_CACHE[key]
     # a miss is a whole-run build: keep core.tick.run_build_count the
@@ -1107,7 +1120,8 @@ def make_overlay_fleet_run(cfg: SimConfig, batch: int,
     from ..core.tick import note_build
     note_build()
     if grid:
-        run = make_grid_fleet_run(cfg, length, batch, start_tick=0)
+        run = make_grid_fleet_run(cfg, length, batch,
+                                  start_tick=start_tick)
         _OVERLAY_FLEET_CACHE[key] = run
         return run
     tick = make_overlay_tick(cfg, use_pallas=False, with_coverage=False)

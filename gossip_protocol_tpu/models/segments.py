@@ -218,6 +218,52 @@ def describe_plan(plan: list[Segment]) -> str:
     return " + ".join(f"{s.flags.tag}:{s.ticks}" for s in plan)
 
 
+#: launch quantum the checkpoint planner aligns to — MUST equal the
+#: grid kernel's ops/pallas/overlay_grid.GRID_TICKS (asserted by
+#: tests/test_elastic.py; not imported here because this module is on
+#: the light bucketing path and must not pull the Pallas stack in)
+CHECKPOINT_GRID_TICKS = 16
+
+
+def checkpoint_ticks(cfg: SimConfig,
+                     grid_ticks: int = CHECKPOINT_GRID_TICKS
+                     ) -> tuple[int, ...]:
+    """The interior segment cuts of a config's tick-0 plan — the ONLY
+    legal snapshot points for fleet checkpointing (core/fleet.py
+    ``launch_leg``).
+
+    A snapshot at a segment cut keeps phase elision static: the resumed
+    run's plan from the cut is exactly the original plan's tail, so the
+    grid path compiles the same specialized kernel variants it would
+    have compiled uninterrupted (a mid-segment cut would split a
+    segment and mint an extra variant).  The cuts are seed-independent
+    (the plan is), so every lane of a fleet — and every seed of a
+    service bucket — agrees on them by construction.
+    """
+    segs = plan_segments(cfg, cfg.total_ticks, 0, grid_ticks)
+    return tuple(s.start for s in segs[1:])
+
+
+def cut_for_budget(cfg: SimConfig, start: int, budget: int,
+                   grid_ticks: int = CHECKPOINT_GRID_TICKS) -> int:
+    """End tick of a resumable leg starting at ``start`` under a
+    ``budget`` of ticks: the whole run when it fits the budget, else
+    the LARGEST legal cut within ``start + budget`` (longest leg that
+    respects the budget), else the smallest cut after ``start`` (the
+    budget is finer than the plan — one oversized leg, documented in
+    docs/SERVING.md "Elastic capacity"), else ``total_ticks``."""
+    total = cfg.total_ticks
+    if not 0 <= start < total:
+        raise ValueError(f"leg start {start} outside [0, {total})")
+    if total - start <= budget:
+        return total
+    cuts = [c for c in checkpoint_ticks(cfg, grid_ticks) if c > start]
+    within = [c for c in cuts if c - start <= budget]
+    if within:
+        return within[-1]
+    return cuts[0] if cuts else total
+
+
 def plan_signature(cfg: SimConfig) -> tuple:
     """Hashable seed-independent digest of a config's segment plan.
 

@@ -116,6 +116,36 @@ def shrink_mesh(mesh: Optional[Mesh]) -> Optional[Mesh]:
     return Mesh(np.array(devs), mesh.axis_names)
 
 
+def grow_mesh(mesh: Optional[Mesh], devices) -> Optional[Mesh]:
+    """One rung UP the elasticity ladder — the inverse of
+    :func:`shrink_mesh`: the same lane mesh plus the next device of
+    ``devices``, the full-strength device tuple the service captured
+    at construction.
+
+    :func:`shrink_mesh` always drops the LAST device, so a degraded
+    mesh's devices are a prefix of ``devices``; growing re-extends the
+    prefix one device at a time (``None`` — the single-device rung —
+    grows straight to a fresh 2-device mesh, mirroring shrink's
+    below-2 collapse).  The grown mesh has a fresh
+    :func:`mesh_descriptor`, so every mesh-keyed program cache misses
+    by construction — and when it re-keys back to a descriptor that
+    served before the loss, the service ProgramCache finds the
+    retained handles and programs again (service/cache.py
+    ``rebind_mesh`` re-keys rather than evicts).  Already at full
+    strength (or ``devices`` is None — the service never had a mesh):
+    returned unchanged.
+    """
+    if devices is None:
+        return mesh
+    devs = list(devices)
+    k = int(mesh.devices.size) if mesh is not None else 1
+    nk = max(2, k + 1)
+    if k >= len(devs) or nk > len(devs):
+        return mesh
+    names = mesh.axis_names if mesh is not None else (LANE_AXIS,)
+    return Mesh(np.array(devs[:nk]), names)
+
+
 def _axes_to_specs(axes):
     """vmap axes tree -> PartitionSpec tree: batched leaves are
     lane-sharded, unbatched leaves (the clock, the shared drop plane)
@@ -283,11 +313,17 @@ class MeshFleetSimulation(FleetSimulation):
                                          shared_drop), build)
 
     # ---- overlay (metrics mode) --------------------------------------
-    def _overlay_fleet_fn(self, batch: int):
+    def _overlay_fleet_fn(self, batch: int,
+                          length: Optional[int] = None,
+                          start_tick: int = 0):
+        # start_tick is accepted for signature parity with the base
+        # class but unused: the mesh path always runs the XLA vmap
+        # tick, which reads the clock from the carried state (the grid
+        # kernel does not shard_map — see the build comment below)
         from ..models.overlay import (OVERLAY_FLEET_STATE_AXES,
                                       OverlayMetrics, OverlaySchedule,
                                       make_overlay_tick)
-        length = self.cfg.total_ticks
+        length = self.cfg.total_ticks if length is None else length
 
         def build():
             # the pure-XLA tick, coverage elided — identical routing to

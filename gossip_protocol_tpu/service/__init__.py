@@ -23,12 +23,14 @@ from .faults import (FAULT_KINDS, FaultInjector, InjectedCompileFailure,
                      InjectedDeviceLoss, InjectedDispatchFailure,
                      InjectedFault)
 from .replay import (Template, build_trace, chaos_replay,
-                     grader_templates, overlay_templates, replay)
+                     elastic_replay, grader_templates,
+                     overlay_templates, replay)
 from .resilience import (BreakerPolicy, BucketQuarantined, CircuitBreaker,
                          DeadlineExceeded, DispatchFailed,
                          PoisonedLaneError, RetryPolicy, ServiceError,
                          ShedRejection, TenantQuotaExceeded,
-                         solo_execute, solo_run, validate_lane)
+                         solo_execute, solo_resume, solo_run,
+                         validate_checkpoint, validate_lane)
 from .scheduler import PAD_POLICIES, FleetService
 from .slo import ClassPolicy, SLOPolicy, default_slo
 from .traffic import (ARRIVAL_KINDS, Arrival, TrafficPattern,
@@ -54,4 +56,7 @@ __all__ = [
     "VirtualClock", "closed_schedule", "make_schedule",
     "outcome_digest", "run_schedule", "ClassPolicy", "SLOPolicy",
     "default_slo", "TenantQuotaExceeded",
+    # the elasticity plane (PR 8): mesh grow + segment-boundary
+    # checkpointing + in-flight lane migration
+    "elastic_replay", "solo_resume", "validate_checkpoint",
 ]

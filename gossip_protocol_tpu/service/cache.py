@@ -54,11 +54,18 @@ class ProgramCache:
         self._chunk_ticks = chunk_ticks
         self._mesh = mesh
         self.max_entries = max_entries
+        # entries are keyed (mesh descriptor, bucket key): rebinding
+        # the mesh RE-KEYS the cache — handles (and their compiled
+        # programs) built for other rungs of the elasticity ladder are
+        # retained under their own descriptor, so a shrink -> grow
+        # cycle finds the original mesh's programs warm instead of
+        # recompiling them (PR 8; the LRU bound still caps the total)
         self._sims: OrderedDict = OrderedDict()
         self.hits = 0
         self.misses = 0
         self.evictions = 0
         self.mesh_rebinds = 0
+        self.rekey_hits = 0
         self._builds0 = run_build_count()
 
     def _make_sim(self, cfg: SimConfig) -> FleetSimulation:
@@ -70,25 +77,35 @@ class ProgramCache:
         return FleetSimulation(cfg, block_size=self._block_size,
                                chunk_ticks=self._chunk_ticks)
 
+    def _desc(self):
+        """Hashable identity of the CURRENT mesh (None: no mesh)."""
+        if self._mesh is None:
+            return None
+        from ..parallel.fleet_mesh import mesh_descriptor
+        return mesh_descriptor(self._mesh)
+
     def get(self, key: tuple, cfg: SimConfig) -> FleetSimulation:
         """The bucket's fleet handle (created on first use).
 
         ``cfg`` seeds the handle's shape on a miss; later calls with
         any same-bucket config return the same handle.  Entries are
         touched LRU-wise; inserting past ``max_entries`` evicts the
-        least recently used bucket AND its compiled programs.  The
+        least recently used entry AND its compiled programs.  The
         cache serves ONE mesh at a time (set at construction;
-        :meth:`rebind_mesh` moves it down the degradation ladder and
-        drops every handle), so the bucket key alone identifies an
-        entry here; cross-mesh staleness is impossible anyway because
-        the handles' compiled programs carry the mesh slot in their
-        own process-cache keys (core/fleet.py ``_mesh_entry``).
+        :meth:`rebind_mesh` moves it along the elasticity ladder), but
+        entries are keyed ``(mesh descriptor, bucket key)``: handles
+        built for OTHER rungs are retained — a grow back to a
+        previously-served mesh re-keys straight to its warm programs.
+        Cross-mesh staleness is impossible either way because the
+        handles' compiled programs carry the mesh slot in their own
+        process-cache keys (core/fleet.py ``_mesh_entry``).
         """
-        sim = self._sims.get(key)
+        full = (self._desc(), key)
+        sim = self._sims.get(full)
         if sim is None:
             self.misses += 1
             sim = self._make_sim(cfg)
-            self._sims[key] = sim
+            self._sims[full] = sim
             if self.max_entries is not None \
                     and len(self._sims) > self.max_entries:
                 _, old = self._sims.popitem(last=False)
@@ -96,25 +113,34 @@ class ProgramCache:
                 self.evictions += 1
         else:
             self.hits += 1
-            self._sims.move_to_end(key)
+            self._sims.move_to_end(full)
         return sim
 
-    def rebind_mesh(self, mesh) -> int:
-        """Graceful mesh degradation (PR 5): re-point the cache at a
-        smaller mesh (or ``None`` for single-device) after a device
-        loss.  Every bucket handle is dropped — their compiled
-        programs target a mesh that no longer exists — and each
-        handle's programs are evicted from the process caches
-        per-handle-exactly (``FleetSimulation.evict_programs``), so
-        sibling buckets owned by OTHER drivers keep theirs.  The next
-        ``get`` per bucket rebuilds on the new mesh through the same
-        mesh-keyed cache keys that already made cross-mesh staleness
-        impossible.  Returns how many bucket handles were dropped."""
-        n = len(self._sims)
-        for sim in self._sims.values():
-            sim.evict_programs()
-        self._sims.clear()
+    def rebind_mesh(self, mesh, evict: bool = False) -> int:
+        """Move the cache along the elasticity ladder (PR 5 shrink /
+        PR 8 grow): re-point it at a different mesh (or ``None`` for
+        single-device).  Entries are RE-KEYED, not dropped — the
+        ladder's other rungs keep their handles and compiled programs
+        under their own mesh descriptor, so a shrink -> grow cycle
+        serves the restored mesh from warm programs (zero rebuilds,
+        tests/test_elastic.py) while the LRU bound still caps total
+        retention.  ``evict=True`` restores the PR-5 behavior — drop
+        everything and evict the programs per-handle-exactly — for
+        deployments where the lost device's executables must actually
+        be freed (a REAL device death; on this image devices are
+        virtual and never die).  Returns how many handles were
+        dropped (0 when re-keying)."""
+        n = 0
+        if evict:
+            n = len(self._sims)
+            for sim in self._sims.values():
+                sim.evict_programs()
+            self._sims.clear()
         self._mesh = mesh
+        # handles already cached under the NEW descriptor were re-keyed
+        # back into service by this rebind (the shrink -> grow payoff)
+        self.rekey_hits += sum(1 for (d, _) in self._sims
+                               if d == self._desc())
         self.mesh_rebinds += 1
         return n
 
@@ -141,6 +167,7 @@ class ProgramCache:
                 "builds": self.builds,
                 "evictions": self.evictions,
                 "mesh_rebinds": self.mesh_rebinds,
+                "rekey_hits": self.rekey_hits,
                 "max_entries": self.max_entries,
                 "devices": (self._mesh.devices.size
                             if self._mesh is not None else 1)}

@@ -41,6 +41,12 @@ poison     one lane of the finished FleetResult is corrupted
 device_loss raised once, at ``device_loss_at`` — a device dropping
            out of the lane mesh; the scheduler shrinks the mesh and
            rebuilds (parallel/fleet_mesh.py ``shrink_mesh``)
+device_return fires once, at ``device_return_at`` — a lost device
+           coming BACK (PR 8 elastic serving).  Not a failure: the
+           scheduler grows the mesh (``grow_mesh``) and re-keys the
+           program cache before launching the attempt, then proceeds
+           normally.  Recorded in :attr:`events` like every fault,
+           so grow events replay digest-for-digest.
 ========== =========================================================
 
 The injector never touches engine code: it is consulted by
@@ -107,6 +113,7 @@ class FaultInjector:
     def __init__(self, seed: int = 0, fault_rate: float = 0.0,
                  kinds=FAULT_KINDS, latency_s: float = 0.05,
                  device_loss_at: Optional[int] = None,
+                 device_return_at: Optional[int] = None,
                  schedule: Optional[dict] = None):
         if not 0.0 <= fault_rate <= 1.0:
             raise ValueError(f"fault_rate must be in [0, 1], got "
@@ -117,16 +124,23 @@ class FaultInjector:
                              f"expected a subset of {FAULT_KINDS}")
         if schedule is not None:
             bad = set(schedule.values()) - set(FAULT_KINDS) \
-                - {"device_loss"}
+                - {"device_loss", "device_return"}
             if bad:
                 raise ValueError(
                     f"unknown fault kinds in schedule {sorted(bad)}; "
-                    f"expected {FAULT_KINDS + ('device_loss',)}")
+                    f"expected {FAULT_KINDS} + ('device_loss', "
+                    "'device_return')")
         self.seed = int(seed)
         self.fault_rate = float(fault_rate)
         self.kinds = tuple(kinds)
         self.base_latency_s = float(latency_s)
         self.device_loss_at = device_loss_at
+        #: ONE attempt index at which a lost device returns (the grow
+        #: half of the elasticity ladder).  Like ``device_loss_at`` it
+        #: wins over the seeded draw at its index — and losing wins
+        #: over returning when both name the same index (a return
+        #: cannot shadow the loss it answers)
+        self.device_return_at = device_return_at
         self.schedule = dict(schedule) if schedule is not None else None
         self.events: list[tuple[int, str]] = []
 
@@ -134,6 +148,9 @@ class FaultInjector:
     def _kind(self, idx: int) -> Optional[str]:
         if self.device_loss_at is not None and idx == self.device_loss_at:
             return "device_loss"
+        if self.device_return_at is not None \
+                and idx == self.device_return_at:
+            return "device_return"
         if self.schedule is not None:
             return self.schedule.get(idx)
         if self.fault_rate <= 0.0 or not self.kinds:
@@ -172,7 +189,20 @@ class FaultInjector:
         rng = np.random.default_rng((self.seed, idx, 2))
         i = int(rng.integers(len(fleet.lanes)))
         lane = fleet.lanes[i]
-        if hasattr(lane, "metrics"):                    # overlay
+        if hasattr(lane, "chunks"):     # a LaneCheckpoint (elastic leg)
+            # corrupt the leg's OWN chunk only: the retry rebuilds
+            # from the PREVIOUS checkpoint, whose chunk list this
+            # replacement never touches (core/fleet.py
+            # _advance_checkpoints copies the list per leg)
+            ch = lane.chunks[-1]
+            if hasattr(ch, "sent"):                     # overlay metrics
+                lane.chunks[-1] = ch.replace(
+                    sent=np.full_like(np.asarray(ch.sent), -1))
+            else:                                       # dense trace tuple
+                a, r, s, rc = ch
+                lane.chunks[-1] = (a, r,
+                                   np.full_like(np.asarray(s), -1), rc)
+        elif hasattr(lane, "metrics"):                  # overlay
             sent = np.asarray(lane.metrics.sent)
             lane.metrics = lane.metrics.replace(
                 sent=np.full_like(sent, -1))
@@ -182,7 +212,8 @@ class FaultInjector:
 
     # ---- provenance --------------------------------------------------
     def summary(self) -> dict:
-        out = {k: 0 for k in FAULT_KINDS + ("device_loss",)}
+        out = {k: 0 for k in FAULT_KINDS
+               + ("device_loss", "device_return")}
         for _, kind in self.events:
             out[kind] += 1
         out["total"] = len(self.events)

@@ -71,7 +71,8 @@ def warm_service(svc: FleetService, templates: Sequence[Template]) -> None:
 
 def probe_capacity_rps(templates: Sequence[Template],
                        n_requests: int = 48, max_batch: int = 8,
-                       seed: int = 0, warm_lap: bool = True) -> float:
+                       seed: int = 0, warm_lap: bool = True,
+                       mesh=None) -> float:
     """Closed-loop burst probe: all ``n_requests`` at t=0, drain; the
     achieved completion rate is the service's max sustainable
     throughput for this catalog — the ladder's 1.0x anchor.  With
@@ -82,7 +83,7 @@ def probe_capacity_rps(templates: Sequence[Template],
     laps = (0, 1) if warm_lap else (1,)
     rate = 0.0
     for lap in laps:
-        svc = FleetService(max_batch=max_batch)
+        svc = FleetService(max_batch=max_batch, mesh=mesh)
         warm_service(svc, templates)
         sched = make_schedule(templates, n_requests, pattern,
                               seed=seed + lap)
@@ -99,11 +100,14 @@ def measure_point(templates: Sequence[Template], n_requests: int,
                   max_wait_s: Optional[float] = 8.0,
                   early_flush: Optional[bool] = None,
                   tenant_quota: Optional[int] = None,
-                  max_queue_depth: Optional[int] = None) -> dict:
+                  max_queue_depth: Optional[int] = None,
+                  mesh=None) -> dict:
     """One wall-paced open-loop run at one offered load; returns the
     load point's row.  Raises on any non-terminal handle or any
     failure that is not a typed load outcome (deadline expiry /
-    admission shed)."""
+    admission shed).  ``mesh`` serves the point from a lane mesh
+    (``max_batch`` becomes per-device — pass ``total // D`` for
+    equal-capacity comparisons against a D=1 point)."""
     eff_slo = slo if early_flush is None \
         else slo.with_early_flush(early_flush)
     pattern = TrafficPattern(kind=kind, rate_rps=rate_rps)
@@ -111,7 +115,7 @@ def measure_point(templates: Sequence[Template], n_requests: int,
                           class_mix=eff_slo.class_mix())
     svc = FleetService(max_batch=max_batch, max_wait_s=max_wait_s,
                        slo=eff_slo, tenant_quota=tenant_quota,
-                       max_queue_depth=max_queue_depth)
+                       max_queue_depth=max_queue_depth, mesh=mesh)
     # warm before the clock starts: programs are process-cached after
     # the capacity probe, but warm() also seeds the per-bucket wall
     # EWMAs the deadline-aware early flush reads — a cold estimate
@@ -243,22 +247,45 @@ def sweep(templates: Sequence[Template], n_requests: int,
 
 def slo_ab(templates: Sequence[Template], n_requests: int,
            rate_rps: float, seed: int, slo: SLOPolicy,
-           **point_kw) -> dict:
+           ordering_ab: bool = True, **point_kw) -> dict:
     """Deadline-aware batch formation ON vs OFF on the SAME schedule
     (same seed, same classes and deadlines — only the early-flush rule
     differs).  The report's ``improved`` is the acceptance gate:
-    strictly fewer deadline misses with the SLO scheduler on."""
+    strictly fewer deadline misses with the SLO scheduler on.
+
+    ``ordering_ab`` additionally runs the SAME schedule with
+    deadline-aware DISPATCH ORDERING off (PR 8 satellite:
+    ``SLOPolicy.class_ordering`` — ``pump()`` pops
+    tightest-deadline-first instead of FIFO over buckets); the
+    ``ordering`` block compares miss rates with ordering on (the
+    early-flush ON leg, which carries it) vs off.  Recorded, not
+    gated: at light load both legs can tie at zero misses.
+    """
     on = measure_point(templates, n_requests, rate_rps, seed, slo,
                        early_flush=True, **point_kw)
     off = measure_point(templates, n_requests, rate_rps, seed, slo,
                         early_flush=False, **point_kw)
-    return {
+    out = {
         "offered_rps": round(rate_rps, 3),
         "on": on, "off": off,
         "miss_rate_on": on["deadline_miss_rate"],
         "miss_rate_off": off["deadline_miss_rate"],
         "improved": on["deadline_miss_rate"] < off["deadline_miss_rate"],
     }
+    if ordering_ab:
+        no_order = measure_point(
+            templates, n_requests, rate_rps, seed,
+            replace(slo, class_ordering=False), early_flush=True,
+            **point_kw)
+        out["ordering"] = {
+            "miss_rate_ordered": on["deadline_miss_rate"],
+            "miss_rate_fifo": no_order["deadline_miss_rate"],
+            "improved": on["deadline_miss_rate"]
+            < no_order["deadline_miss_rate"],
+            "no_worse": on["deadline_miss_rate"]
+            <= no_order["deadline_miss_rate"],
+        }
+    return out
 
 
 def replay_check(templates: Sequence[Template], n_requests: int,
@@ -347,4 +374,17 @@ def load_openloop_bench(smoke: bool = False, seed: int = 20260804,
         "replay_check": rc,
         "bench_wall_s": round(now() - t0, 1),
     }
+    # lane-mesh load point (PR 8 satellite): the knee-load point once
+    # more, served from a D=2 lane mesh at EQUAL total capacity
+    # (max_batch halves per device) — recorded only when virtual
+    # devices are live (XLA_FLAGS forces them; plain CPU runs have 1)
+    import jax
+    if jax.device_count() >= 2:
+        from ..parallel.fleet_mesh import make_lane_mesh
+        n_pt = max(12, n_point // 3)
+        mesh_row = measure_point(
+            templates, n_pt, rate_rps=0.75 * cap, seed=seed + 300,
+            slo=slo, max_batch=4, mesh=make_lane_mesh(2))
+        entry["mesh_point"] = {"devices": 2, "max_batch_per_device": 4,
+                               **mesh_row}
     return entry

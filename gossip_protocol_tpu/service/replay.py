@@ -420,3 +420,174 @@ def chaos_replay(templates: list[Template], seeds_per_template: int,
     if return_legs:
         return metrics, (seq_results, seq_wall)
     return metrics
+
+
+def elastic_replay(templates: list[Template], seeds_per_template: int,
+                   max_batch: int = 4, mesh=None,
+                   checkpoint_every: int = 32, fault_seed: int = 0,
+                   fault_rate: float = 0.0, device_loss_at="mid",
+                   device_return_at="after", max_retries: int = 4,
+                   backoff_base_s: float = 0.01, sequential=None,
+                   return_legs: bool = False,
+                   pipeline: bool | None = None):
+    """The elastic acceptance harness (PR 8): the mixed replay served
+    as RESUMABLE LEGS (``checkpoint_every`` segment budget) under one
+    seeded device loss AND one device return, with the gate enforced
+    in-line:
+
+    * **100% completion, 0 stranded handles** — like the chaos gate;
+    * **zero lanes restarted from tick 0** — every lane interrupted
+      after its first checkpoint resumes from that checkpoint (the
+      scheduler's ``restarted_lanes`` counter must be 0), and the
+      harness additionally requires that checkpoints, resume
+      dispatches, and — when a mesh rides — cross-rebuild lane
+      migrations actually happened (a run too small to exercise them
+      raises rather than passing vacuously);
+    * **shrink -> grow round trip** — the device loss shrinks the
+      mesh, the device return grows it back; the service must end at
+      its starting device count with at least one ``mesh_grows``;
+    * **bit-parity for every request** against the sequential solo
+      leg (degraded requests are solo-RESUMED from their checkpoint —
+      still exact);
+    * **replayability** — fault schedule + per-request outcomes
+      (status, retries, legs) are pure functions of the seeded
+      arguments, digest-comparable across two runs.
+
+    ``device_loss_at="mid"`` places the loss mid-stream by attempt
+    index; ``device_return_at="after"`` a few attempts later (pass
+    ints to pin either).  ``sequential=``/``return_legs=`` share one
+    solo baseline across configurations, like :func:`replay`.
+    """
+    from .faults import FaultInjector
+    from .resilience import BreakerPolicy, RetryPolicy
+    trace = build_trace(templates, seeds_per_template)
+    n_dev = int(mesh.devices.size) if mesh is not None else 1
+    cap = max(1, max_batch * n_dev)
+    base_dispatches = max(1, -(-len(trace) // cap))
+    if device_loss_at == "mid":
+        # with legs the attempt stream is ~2-4x the batch count; the
+        # base count lands the loss inside the leg stream's first half,
+        # when checkpoints already exist
+        device_loss_at = max(2, base_dispatches)
+    if device_return_at == "after":
+        device_return_at = device_loss_at + max(2, base_dispatches // 2)
+    injector = FaultInjector(seed=fault_seed, fault_rate=fault_rate,
+                             device_loss_at=device_loss_at,
+                             device_return_at=device_return_at)
+    svc = FleetService(
+        max_batch=max_batch, mesh=mesh, injector=injector,
+        retry=RetryPolicy(max_retries=max_retries,
+                          backoff_base_s=backoff_base_s,
+                          seed=fault_seed),
+        # same determinism pins as chaos_replay: no time-based flushes,
+        # an opened bucket stays deterministically quarantined
+        breaker=BreakerPolicy(reset_after_s=float("inf")),
+        checkpoint_every=checkpoint_every, pipeline=pipeline)
+    warm(trace, svc)
+    if sequential is None:
+        seq_results, seq_wall = run_sequential(trace)
+    else:
+        seq_results, seq_wall = sequential
+        if len(seq_results) != len(trace):
+            raise ValueError(
+                f"sequential= leg has {len(seq_results)} results but "
+                f"the trace has {len(trace)} requests")
+    t0 = time.perf_counter()
+    handles = [svc.submit(tpl.cfg, seed=seed, mode=tpl.mode)
+               for tpl, seed in trace]
+    svc.drain()
+    svc_wall = time.perf_counter() - t0
+
+    stranded = [h.request.rid for h in handles if not h.done]
+    failed = [h.request.rid for h in handles if h.failed]
+    if stranded or failed:
+        errs = "; ".join(
+            f"rid {h.request.rid}: {h.exception()!r}"
+            for h in handles if h.failed)[:500]
+        raise RuntimeError(
+            f"elastic replay left {len(stranded)} stranded and "
+            f"{len(failed)} failed handles of {len(handles)} "
+            f"(seed={fault_seed}): {errs}")
+    svc_results = [h.result() for h in handles]
+    bad = verify_parity(trace, seq_results, svc_results)
+    if bad:
+        raise RuntimeError(
+            f"elastic replay diverged from solo runs ({len(bad)}): "
+            + "; ".join(bad[:5]))
+    stats = svc.stats()
+    summary = injector.summary()
+    if summary["device_loss"] < 1 or summary["device_return"] < 1:
+        raise RuntimeError(
+            f"elastic replay injected {summary['device_loss']} device "
+            f"losses / {summary['device_return']} returns; the gate "
+            "needs >= 1 of each — the attempt stream never reached "
+            f"indices {device_loss_at}/{device_return_at} (stream too "
+            "small for the leg budget?)")
+    el = stats["elastic"]
+    if el["restarted_lanes"] != 0:
+        raise RuntimeError(
+            f"elastic replay restarted {el['restarted_lanes']} "
+            "checkpointed lane(s) from tick 0; interrupted lanes must "
+            "resume from their last checkpoint")
+    if el["checkpoints_taken"] < 1 or el["resume_dispatches"] < 1:
+        raise RuntimeError(
+            f"elastic replay took {el['checkpoints_taken']} "
+            f"checkpoints / {el['resume_dispatches']} resume "
+            "dispatches; the gate is vacuous without resumable legs — "
+            "lower checkpoint_every or lengthen the configs")
+    if mesh is not None:
+        if el["lanes_migrated"] < 1:
+            raise RuntimeError(
+                "elastic replay migrated no lanes across the mesh "
+                "rebuild; the loss/return events missed every "
+                "checkpointed batch")
+        if el["mesh_grows"] < 1 or stats["devices"] != n_dev:
+            raise RuntimeError(
+                f"elastic replay ended at {stats['devices']} devices "
+                f"(started {n_dev}, grows={el['mesh_grows']}); the "
+                "returned device was never reclaimed")
+    degraded = [h.request.rid for h in handles
+                if h.status == "degraded"]
+    outcomes = [(h.request.rid, h.status, h.metrics.retries,
+                 h.metrics.legs) for h in handles]
+    import hashlib
+    outcome_digest = hashlib.sha256(
+        repr(outcomes).encode()).hexdigest()[:16]
+    metrics = {
+        "requests": len(trace),
+        "completed": len(svc_results),
+        "stranded": 0,
+        "failed": 0,
+        "completion_rate": 1.0,
+        "degraded_requests": len(degraded),
+        "parity_checked": True,
+        "fault_seed": fault_seed,
+        "fault_rate": fault_rate,
+        "checkpoint_every": checkpoint_every,
+        "device_loss_at": device_loss_at,
+        "device_return_at": device_return_at,
+        "faults": summary,
+        "fault_events": list(injector.events),
+        "schedule_digest": injector.schedule_digest(),
+        "outcome_digest": outcome_digest,
+        "outcomes": outcomes,
+        "elastic": el,
+        "restarted_from_zero": el["restarted_lanes"],
+        "mean_legs": round(sum(o[3] for o in outcomes)
+                           / max(len(outcomes), 1), 2),
+        "cache_rekey_hits": stats["cache"]["rekey_hits"],
+        "failures": stats["failures"],
+        "devices_start": n_dev,
+        "devices_end": stats["devices"],
+        "sequential_wall_s": round(seq_wall, 3),
+        "service_wall_s": round(svc_wall, 3),
+        "speedup_vs_sequential": round(seq_wall / svc_wall, 2),
+        "latency_p50_s": stats["latency_p50_s"],
+        "latency_p95_s": stats["latency_p95_s"],
+        "mean_occupancy": stats["mean_occupancy"],
+        "dispatches": stats["dispatches"],
+        "pipeline": stats["pipeline"],
+    }
+    if return_legs:
+        return metrics, (seq_results, seq_wall)
+    return metrics

@@ -238,6 +238,25 @@ def validate_lane(req, lane) -> Optional[str]:
     return None
 
 
+def validate_checkpoint(req, ck) -> Optional[str]:
+    """Per-lane sanity on a non-final resolved LEG (PR 8 elastic
+    serving): the snapshot's clock advanced and the leg's own output
+    chunk carries sane counters — which is what a poisoned leg
+    (service/faults.py) breaks.  A failing leg is retried from the
+    PREVIOUS checkpoint, exactly like any other dispatch failure."""
+    if ck.tick <= 0 or ck.tick > req.cfg.total_ticks:
+        return f"checkpoint clock {ck.tick} outside (0, " \
+               f"{req.cfg.total_ticks}]"
+    if not ck.chunks:
+        return "checkpoint carries no output chunks"
+    chunk = ck.chunks[-1]
+    sent = np.asarray(chunk.sent if hasattr(chunk, "sent")
+                      else chunk[2])
+    if sent.size and int(sent.min()) < 0:
+        return "negative message counters in the checkpointed segment"
+    return None
+
+
 # ---- the degradation ladder's bottom rung ----------------------------
 def solo_execute(cfg, mode: str):
     """ONE direct single-simulation execution — no fleet program, no
@@ -265,3 +284,46 @@ def solo_run(req):
     gate promises bit-parity for non-degraded requests and
     correctness for degraded ones.)"""
     return solo_execute(req.cfg, req.mode)
+
+
+def solo_resume(req):
+    """The bottom rung for a CHECKPOINTED request (PR 8): resume the
+    lane's solo continuation from its latest segment-boundary snapshot
+    instead of re-running from tick 0, then stitch the accumulated
+    chunks into the full-horizon result through the same assembly the
+    fleet path uses (core/fleet.finish_lane) — so even a request that
+    falls all the way down the ladder never loses checkpointed work,
+    and its result stays bit-identical to an uninterrupted solo run
+    (the schedule is closed-form in the carried clock)."""
+    import dataclasses as _dc
+
+    import jax
+
+    from ..core.fleet import finish_lane
+    ck = req.resume
+    cfg = ck.cfg
+    if cfg.model == "overlay":
+        from ..models.overlay import (OverlaySimulation,
+                                      overlay_state_from_host)
+        state = overlay_state_from_host(
+            {**ck.state, "tick": np.int32(ck.tick)})
+        res = OverlaySimulation(cfg, use_pallas=False).run(
+            resume_from=state)
+        final = res.final_state
+        chunk = jax.tree.map(np.asarray, res.metrics)
+    else:
+        from ..core.sim import Simulation
+        from ..state import state_from_host
+        state = state_from_host({**ck.state, "tick": np.int32(ck.tick)})
+        res = Simulation(cfg).run(resume_from=state)
+        final = res.final_state
+        # solo SimResult counters are (N, T_segment); chunks ride (T, N)
+        chunk = (res.added, res.removed, res.sent.T, res.recv.T)
+    done = _dc.replace(
+        ck, tick=cfg.total_ticks,
+        state={f.name: np.asarray(getattr(final, f.name))
+               for f in _dc.fields(type(final)) if f.name != "tick"},
+        chunks=list(ck.chunks) + [chunk],
+        wall_seconds=ck.wall_seconds + res.wall_seconds,
+        legs=ck.legs + 1, mesh_desc=None)
+    return finish_lane(done)

@@ -63,6 +63,21 @@ per-tenant admission quotas (``tenant_quota``) layer on
 the rest.  ``stats()`` splits latency windows per priority class and
 ``pump_harvest=False`` pins the idle in-flight harvest off for
 deterministic virtual-clock traffic replays.
+
+Elastic plane (PR 8, docs/SERVING.md "Elastic capacity"): the ladder
+churns BOTH ways.  ``checkpoint_every=`` serves long dispatches as
+RESUMABLE LEGS — each leg ends at a PR-1 segment cut
+(models/segments.cut_for_budget), the fleet carry is snapshotted to
+host numpy (core/fleet.py ``launch_leg``/``LaneCheckpoint``), and the
+batch re-queues under a resume sub-bucket — so any failure retries
+from the last checkpoint, never tick 0 (even the solo fallback
+resumes, ``solo_resume``).  A fault-plane "device_return" event grows
+the mesh back (``grow_mesh``); ``ProgramCache.rebind_mesh`` RE-KEYS
+instead of evicting, so a shrink -> grow cycle finds the restored
+mesh's programs warm, and queued + checkpointed lanes MIGRATE across
+every rebuild (the snapshots are mesh-independent).  SLO classes now
+also shape dispatch ORDER: ``pump()`` pops
+tightest-queued-deadline-first (``SLOPolicy.class_ordering``).
 """
 
 from __future__ import annotations
@@ -75,7 +90,9 @@ from typing import Optional
 import numpy as np
 
 from ..config import SimConfig
+from ..core.fleet import FleetLeg
 from ..core.tick import run_build_count
+from ..models.segments import cut_for_budget
 from .bucket import bucket_key, pad_configs
 from .cache import ProgramCache
 from .faults import FaultInjector, InjectedCompileFailure, \
@@ -83,7 +100,8 @@ from .faults import FaultInjector, InjectedCompileFailure, \
 from .resilience import (BreakerPolicy, BucketQuarantined, CircuitBreaker,
                          DeadlineExceeded, DispatchFailed,
                          PoisonedLaneError, RetryPolicy, ShedRejection,
-                         TenantQuotaExceeded, solo_run, validate_lane)
+                         TenantQuotaExceeded, solo_resume, solo_run,
+                         validate_checkpoint, validate_lane)
 from .slo import SLOPolicy
 from .types import MODES, RequestHandle, RequestMetrics, SimRequest
 
@@ -151,7 +169,8 @@ class FleetService:
                  pipeline: Optional[bool] = None,
                  slo: Optional[SLOPolicy] = None,
                  tenant_quota: Optional[int] = None,
-                 pump_harvest: Optional[bool] = None):
+                 pump_harvest: Optional[bool] = None,
+                 checkpoint_every: Optional[int] = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if pad_policy not in PAD_POLICIES:
@@ -163,11 +182,30 @@ class FleetService:
         if tenant_quota is not None and tenant_quota < 1:
             raise ValueError(f"tenant_quota must be >= 1 or None, "
                              f"got {tenant_quota}")
+        if checkpoint_every is not None and checkpoint_every < 1:
+            raise ValueError(f"checkpoint_every must be >= 1 or None, "
+                             f"got {checkpoint_every}")
         self.max_batch = max_batch
         self.max_wait_s = max_wait_s
         self.pad_policy = pad_policy
         self.mesh = mesh
         self.n_devices = int(mesh.devices.size) if mesh is not None else 1
+        #: the full-strength device tuple, captured at construction —
+        #: the elasticity ladder's top rung: ``grow_mesh`` re-extends
+        #: a degraded mesh back toward exactly these devices (PR 8)
+        self._full_devices = tuple(mesh.devices.flat) \
+            if mesh is not None else None
+        #: segment budget (ticks) above which a dispatch runs as
+        #: RESUMABLE LEGS (PR 8 elastic serving): each leg ends at a
+        #: PR-1 segment cut (models/segments.cut_for_budget), the
+        #: fleet carry is snapshotted host-side, and the batch
+        #: re-queues as resume-requests — so device loss mid-sequence
+        #: loses at most one leg, never the run, and checkpointed
+        #: lanes migrate across mesh rebuilds.  None (default):
+        #: monolithic dispatches, the pre-PR-8 behavior.  Dense
+        #: bench-mode requests are always monolithic (their program
+        #: compiles the active-corner width whole-run).
+        self.checkpoint_every = checkpoint_every
         self.clock = clock
         self.cache = ProgramCache(block_size=block_size,
                                   chunk_ticks=chunk_ticks, mesh=mesh,
@@ -260,9 +298,21 @@ class FleetService:
             "retries": 0, "backoff_s": 0.0, "deadline_misses": 0,
             "shed": 0, "breaker_opens": 0, "degraded_dispatches": 0,
             "degraded_requests": 0, "failed_requests": 0,
-            "device_losses": 0, "mesh_rebuilds": 0,
+            "device_losses": 0, "device_returns": 0,
+            "mesh_rebuilds": 0,
             "faults_injected": 0, "poisoned_lanes": 0,
             "injected_latency_s": 0.0,
+        }
+        # the elasticity counters (PR 8): lifetime-exact, reported in
+        # stats()["elastic"] so a grow seed's replay can be compared
+        # event-for-event.  restarted_lanes counts checkpointed work
+        # ever re-run from tick 0 — structurally 0 (retries resume
+        # from the last checkpoint; even the solo fallback resumes)
+        # and gated on 0 by the elastic replay harness.
+        self._elastic = {
+            "mesh_grows": 0, "checkpoints_taken": 0,
+            "lanes_migrated": 0, "resume_dispatches": 0,
+            "restarted_lanes": 0,
         }
 
     # ---- admission ---------------------------------------------------
@@ -379,7 +429,7 @@ class FleetService:
         """
         n = 0
         self._expire_deadlines(self.clock())
-        for key in list(self._queues):
+        for key in self._pump_order():
             q = self._queues[key]
             while len(q) >= self.capacity:
                 self._dispatch(key)
@@ -404,6 +454,34 @@ class FleetService:
                 and self._inflight.pending.is_ready():
             self.resolve_inflight()
         return n
+
+    def _pump_order(self) -> list:
+        """The bucket order one ``pump()`` pass serves.
+
+        FIFO over bucket creation order, UNLESS an SLO policy with
+        ``class_ordering`` rides (PR 8 satellite): then buckets are
+        popped tightest-queued-deadline first — through PR 7 classes
+        shaped deadlines but not dispatch order, so an interactive
+        batch could sit a full dispatch wall behind a deadline-less
+        bulk bucket that merely enqueued earlier.  Deadline-less
+        buckets keep FIFO order after every deadline-carrying one.
+        Deterministic: deadlines are pure schedule values on a virtual
+        clock and ties break on creation order, so digest replays are
+        unaffected (tests/test_traffic.py).
+        """
+        keys = list(self._queues)
+        if self.slo is None \
+                or not getattr(self.slo, "class_ordering", True):
+            return keys
+        pos = {k: i for i, k in enumerate(keys)}
+
+        def tightness(k):
+            dls = [r.deadline_s for r in self._queues[k]
+                   if r.deadline_s is not None]
+            return (min(dls) if dls else float("inf"), pos[k])
+
+        keys.sort(key=tightness)
+        return keys
 
     def _harvest_enabled(self) -> bool:
         """Whether an idle ``pump()`` may resolve a ready in-flight
@@ -448,15 +526,34 @@ class FleetService:
         resolve any in-flight batch: after ``flush()`` returns, every
         request that was queued or in flight has reached a terminal
         handle state (the post-PR-6 flush guarantee; under pipelining
-        a ``pump()`` alone may leave the newest batch in flight)."""
+        a ``pump()`` alone may leave the newest batch in flight) — OR,
+        under checkpointed serving (PR 8), has been advanced one leg
+        and re-queued under its next resume sub-bucket.  A whole-
+        service flush loops until every queue is empty AND nothing is
+        in flight, so its terminal guarantee covers legs too (each
+        pass advances every leg at least one cut — the loop is
+        finite); a single-bucket flush drains that bucket once
+        (``RequestHandle.result`` re-flushes the request's CURRENT
+        bucket as it moves)."""
         n = 0
         self._expire_deadlines(self.clock())
-        keys = [bucket] if bucket is not None else list(self._queues)
-        for key in keys:
-            while self._queues.get(key):
-                self._dispatch(key)
+        if bucket is not None:
+            while self._queues.get(bucket):
+                self._dispatch(bucket)
                 n += 1
-        self.resolve_inflight()
+            self.resolve_inflight()
+            return n
+        while True:
+            keys = [k for k in self._queues if self._queues[k]]
+            if not keys and self._inflight is None:
+                break
+            for key in keys:
+                while self._queues.get(key):
+                    self._dispatch(key)
+                    n += 1
+            # resolving may CHECKPOINT the in-flight batch and
+            # re-queue it one leg further — loop back around
+            self.resolve_inflight()
         return n
 
     def drain(self) -> int:
@@ -483,6 +580,43 @@ class FleetService:
         return False
 
     # ---- dispatch ----------------------------------------------------
+    @staticmethod
+    def _base_key(key: tuple) -> tuple:
+        """A queue key without its resume marker (PR 8): checkpointed
+        batches queue under ``base + (("resume", tick),)`` — lanes at
+        different clocks must never share a dispatch (a fleet shares
+        ONE scan clock) — but the program cache, circuit breaker, and
+        per-bucket stats all speak the BASE bucket."""
+        if key and isinstance(key[-1], tuple) and key[-1] \
+                and key[-1][0] == "resume":
+            return key[:-1]
+        return key
+
+    def _leg_ticks(self, reqs: list) -> Optional[int]:
+        """Leg length for this batch (None: monolithic dispatch).
+
+        A batch runs as resumable legs when ``checkpoint_every`` is
+        set, the engine supports the mode (every overlay request;
+        dense ``trace``), and the config's segment plan offers an
+        interior cut — each leg ends at the cut
+        ``models/segments.cut_for_budget`` picks.  Resumed batches
+        ALWAYS take the leg path (their carry lives in checkpoints).
+        All lanes of a batch share the plan (the bucket pins the plan
+        signature, and cuts are seed-independent), so one leg length
+        serves the whole batch."""
+        if self.checkpoint_every is None:
+            return None
+        r0 = reqs[0]
+        cfg = r0.cfg
+        if cfg.model != "overlay" and r0.mode != "trace":
+            return None     # dense bench: monolithic by construction
+        start = r0.resume.tick if r0.resume is not None else 0
+        end = cut_for_budget(cfg, start, self.checkpoint_every)
+        if r0.resume is None and end >= cfg.total_ticks:
+            return None     # no interior cut (or the run fits the
+            #                 budget): nothing to checkpoint
+        return end - start
+
     def _width(self, k: int) -> int:
         """Compiled lane width for a ``k``-request batch.
 
@@ -586,7 +720,7 @@ class FleetService:
         if not reqs:
             return
         t_q0 = now              # queue wait ends at the first attempt
-        if not self.breaker.allow(key, now):
+        if not self.breaker.allow(self._base_key(key), now):
             # quarantined bucket: straight to the ladder's bottom rung
             self._degrade_batch(key, reqs, t_q0, retries=0)
             return
@@ -613,7 +747,7 @@ class FleetService:
         if not reqs:
             return
         t_q0 = now
-        if not self.breaker.allow(key, now):
+        if not self.breaker.allow(self._base_key(key), now):
             # resolve the in-flight batch first: the quarantined
             # bucket's solo runs (and their sleeps) must not strand
             # it, nor contend with its still-executing program
@@ -661,7 +795,7 @@ class FleetService:
             except BaseException:
                 self._requeue_unresolved(key, reqs)
                 raise
-            self.breaker.record_success(key)
+            self.breaker.record_success(self._base_key(key))
             self._complete_batch(key, reqs, fleet, width, builds, t_q0,
                                  retries=0)
             return
@@ -724,7 +858,7 @@ class FleetService:
         except BaseException:
             self._requeue_unresolved(infl.key, infl.reqs)
             raise
-        self.breaker.record_success(infl.key)
+        self.breaker.record_success(self._base_key(infl.key))
         self._complete_batch(infl.key, infl.reqs, fleet, infl.width,
                              infl.builds, infl.t_q0, retries=0)
 
@@ -755,7 +889,7 @@ class FleetService:
                 idx=idx, fault=fault, builds=builds, t_q0=t_q0))
         except Exception as e:
             return e, idx
-        self.breaker.record_success(key)
+        self.breaker.record_success(self._base_key(key))
         self._complete_batch(key, reqs, fleet, width, builds, t_q0,
                              retries=retries)
         return None, idx
@@ -772,7 +906,7 @@ class FleetService:
                 self._failures["device_losses"] += 1
                 if self.mesh is not None:
                     self._degrade_mesh()
-            if self.breaker.record_failure(key, self.clock()):
+            if self.breaker.record_failure(self._base_key(key), self.clock()):
                 self._failures["breaker_opens"] += 1
             now = self.clock()
             reqs = self._drop_expired(reqs, now)
@@ -807,19 +941,47 @@ class FleetService:
         ``defer=True`` it is only STAGED (``PendingFleet.start()``
         dispatches), which is how the pipelined path keeps the next
         program off the cores until the previous batch resolves."""
+        if fault == "device_return":
+            # the elastic fault event (PR 8): a lost device came back.
+            # Not a failure — grow the mesh BEFORE this launch so the
+            # batch (and every checkpointed lane it carries) lands on
+            # the wider mesh, then proceed normally.
+            self._failures["device_returns"] += 1
+            self._grow_mesh()
+            fault = None
         if fault == "device_loss":
             raise InjectedDeviceLoss(idx)
         if fault == "compile":
             # the program-build boundary, before the bucket handle is
             # even looked up
             raise InjectedCompileFailure(idx)
+        base = self._base_key(key)
         cfgs = [r.cfg for r in reqs]
         width = self._width(len(cfgs))
-        padded = pad_configs(cfgs, width, self._filler[key])
-        sim = self.cache.get(key, cfgs[0])
+        sim = self.cache.get(base, cfgs[0])
         if fault == "dispatch":
             raise InjectedDispatchFailure(idx)
-        if reqs[0].mode == "bench":
+        leg = self._leg_ticks(reqs)
+        if leg is not None and reqs[0].resume is not None:
+            # resume legs: the batch re-enters the scan from its
+            # checkpoints; filler is replicated from lane 0's snapshot
+            # inside the engine.  A mesh change since the snapshot is
+            # a MIGRATION — the mesh-independent host carry re-stacks
+            # at the new width on the new mesh.
+            cks = [r.resume for r in reqs]
+            moved = sum(1 for ck in cks
+                        if ck.mesh_desc != sim._mesh_entry())
+            self._elastic["lanes_migrated"] += moved
+            self._elastic["resume_dispatches"] += 1
+            pending = sim.launch_leg(resume=cks, ticks=leg,
+                                     width=width, defer=defer)
+            return pending, width
+        padded = pad_configs(cfgs, width, self._filler[base])
+        if leg is not None:
+            pending = sim.launch_leg(configs=padded, ticks=leg,
+                                     n_real=len(reqs),
+                                     mode=reqs[0].mode, defer=defer)
+        elif reqs[0].mode == "bench":
             pending = sim.launch_bench(configs=padded, warmup=False,
                                        n_real=len(reqs), defer=defer)
         else:
@@ -849,15 +1011,80 @@ class FleetService:
                     f"dispatch unstacked {len(fleet.lanes)} lanes for "
                     f"{len(infl.reqs)} requests; filler lanes must "
                     "never be unstacked"))
+        if isinstance(fleet, FleetLeg) and not fleet.done:
+            # a non-final leg: validate the checkpoints (a poisoned
+            # leg fails HERE and retries from the previous snapshot,
+            # exactly like any dispatch failure) and hand the leg up
+            # for _complete_batch's checkpoint-and-requeue branch
+            for r, ck in zip(infl.reqs, fleet.lanes):
+                why = validate_checkpoint(r, ck)
+                if why is not None:
+                    raise PoisonedLaneError(r.rid, why)
+            return fleet
+        if isinstance(fleet, FleetLeg):
+            # final leg: assemble the full-horizon results (pure host
+            # work) — validation below covers the stitched chunks, so
+            # a poisoned final leg is still caught before completion
+            fleet = fleet.results()
         for r, lane in zip(infl.reqs, fleet.lanes):
             why = validate_lane(r, lane)
             if why is not None:
                 raise PoisonedLaneError(r.rid, why)
         return fleet
 
+    def _checkpoint_batch(self, key: tuple, reqs: list, leg: FleetLeg,
+                          width: int, builds: int, t_q0: float,
+                          retries: int) -> None:
+        """A non-final leg resolved: snapshot taken.  Attach each
+        lane's checkpoint to its request and re-queue the batch under
+        the next leg's resume sub-bucket — the handles stay pending
+        (continuing work, not a terminal state), and the next
+        ``pump``/``flush`` dispatches the next leg.  Counted as a
+        dispatch (it is one: a compiled program ran) with its own
+        wall-decomposition row."""
+        base = self._base_key(key)
+        occupancy = len(reqs) / width
+        wall = float(leg.wall_seconds)
+        alpha = self.slo.wall_ewma_alpha if self.slo is not None else 0.3
+        prev = self._bucket_wall.get(key)
+        # per QUEUE key: a leg's wall describes its own length, not
+        # the base bucket's monolithic dispatch wall
+        self._bucket_wall[key] = wall if prev is None \
+            else (1.0 - alpha) * prev + alpha * wall
+        sub = base + (("resume", leg.checkpoints[0].tick),)
+        q = self._queues.setdefault(sub, deque())
+        for req, ck in zip(reqs, leg.checkpoints):
+            req.resume = ck
+            req.bucket = sub
+            self._handles[req.rid]._launched = False
+            q.append(req)
+            self._tenant_note(req.tenant, +1)
+        self._elastic["checkpoints_taken"] += 1
+        self._dispatches.append({"bucket": base, "batch": len(reqs),
+                                 "width": width, "occupancy": occupancy,
+                                 "wall_s": wall, "builds": builds,
+                                 "pack_s": float(leg.pack_seconds),
+                                 "device_wait_s":
+                                     float(leg.device_seconds),
+                                 "fetch_s": float(leg.fetch_seconds),
+                                 "host_s": float(leg.pack_seconds)
+                                 + float(leg.fetch_seconds),
+                                 "retries": retries})
+        self._dispatch_count += 1
+        bs = self._bucket_stats[base]
+        bs["dispatches"] += 1
+        bs["builds"] += builds
+
     def _complete_batch(self, key: tuple, reqs: list, fleet, width: int,
                         builds: int, t_q0: float,
                         retries: int) -> None:
+        if isinstance(fleet, FleetLeg):
+            # _finish_attempt converts final legs to FleetResults, so
+            # a FleetLeg here is a non-final snapshot: checkpoint +
+            # re-queue instead of completing
+            self._checkpoint_batch(key, reqs, fleet, width, builds,
+                                   t_q0, retries)
+            return
         occupancy = len(reqs) / width
         # the dispatch wall decomposes into pack (host staging +
         # dispatch) / execute (device wait — under pipelining this
@@ -866,43 +1093,54 @@ class FleetService:
         # launch/resolve boundaries — so a mesh speedup lands in the
         # execute column and a staging win in pack/fetch, and none of
         # it needs a block_until_ready on the hot path
+        base = self._base_key(key)
         pack = float(fleet.pack_seconds)
         device_wait = float(fleet.device_seconds)
         fetch = float(fleet.fetch_seconds)
+        # the REQUEST's run wall: accumulated across every leg of a
+        # checkpointed run (FleetLeg.results sums them; equals the
+        # decomposition sum on the monolithic path)
         wall = float(fleet.wall_seconds)
+        # THIS dispatch's own wall: what the SLO early-flush EWMA and
+        # the per-dispatch log row must see — on a final leg the
+        # accumulated wall would overstate the next dispatch in this
+        # queue by ~the leg count
+        leg_wall = pack + device_wait + fetch
         now = self.clock()
         # fold this dispatch's wall into the bucket's EWMA — the
         # early-flush estimate (service/slo.py) for the NEXT partial
         # batch in this bucket
         alpha = self.slo.wall_ewma_alpha if self.slo is not None else 0.3
         prev = self._bucket_wall.get(key)
-        self._bucket_wall[key] = wall if prev is None \
-            else (1.0 - alpha) * prev + alpha * wall
+        self._bucket_wall[key] = leg_wall if prev is None \
+            else (1.0 - alpha) * prev + alpha * leg_wall
         for req, lane in zip(reqs, fleet.lanes):
             missed = req.deadline_s is not None and now > req.deadline_s
             if missed:
                 self._failures["deadline_misses"] += 1
+            legs = req.resume.legs + 1 if req.resume is not None else 1
+            req.resume = None       # the run is over; free the snapshot
             self._handles.pop(req.rid)._complete(lane, RequestMetrics(
-                rid=req.rid, bucket=key, mode=req.mode,
+                rid=req.rid, bucket=base, mode=req.mode,
                 queue_wait_s=t_q0 - req.submit_s, run_wall_s=wall,
                 latency_s=now - req.submit_s, batch=len(reqs),
                 padded_batch=width, occupancy=occupancy,
                 cache_hit=builds == 0, builds=builds, retries=retries,
                 deadline_missed=missed, priority=req.priority,
-                tenant=req.tenant))
+                tenant=req.tenant, legs=legs))
             self._latencies.append(now - req.submit_s)
             self._note_class_terminal(req, now - req.submit_s, missed)
         self._completed += len(reqs)
-        self._dispatches.append({"bucket": key, "batch": len(reqs),
+        self._dispatches.append({"bucket": base, "batch": len(reqs),
                                  "width": width, "occupancy": occupancy,
-                                 "wall_s": wall, "builds": builds,
+                                 "wall_s": leg_wall, "builds": builds,
                                  "pack_s": pack,
                                  "device_wait_s": device_wait,
                                  "fetch_s": fetch,
                                  "host_s": pack + fetch,
                                  "retries": retries})
         self._dispatch_count += 1
-        bs = self._bucket_stats[key]
+        bs = self._bucket_stats[base]
         bs["dispatches"] += 1
         bs["builds"] += builds
 
@@ -923,8 +1161,25 @@ class FleetService:
                     req.rid, max(retries, 1), last_err), cause=last_err)
                 continue
             t0 = self.clock()
+            legs = 1
             try:
-                res = solo_run(req)
+                if req.resume is not None:
+                    # even the ladder's bottom rung preserves
+                    # checkpointed work: resume the solo continuation
+                    # from the lane's snapshot (service/resilience.py
+                    # solo_resume) instead of re-running from tick 0
+                    legs = req.resume.legs + 1
+                    try:
+                        res = solo_resume(req)
+                    except Exception:
+                        # the snapshot could not be resumed — re-run
+                        # whole (correct, but checkpointed work is
+                        # lost: the one counted restart path)
+                        self._elastic["restarted_lanes"] += 1
+                        legs = 1
+                        res = solo_run(req)
+                else:
+                    res = solo_run(req)
             except Exception as e:
                 self._fail_request(req, DispatchFailed(
                     req.rid, retries + 1, e), cause=e)
@@ -934,14 +1189,15 @@ class FleetService:
             if missed:
                 self._failures["deadline_misses"] += 1
             self._failures["degraded_requests"] += 1
+            req.resume = None
             self._handles.pop(req.rid)._complete(res, RequestMetrics(
-                rid=req.rid, bucket=key, mode=req.mode,
+                rid=req.rid, bucket=self._base_key(key), mode=req.mode,
                 queue_wait_s=t_q0 - req.submit_s,
                 run_wall_s=now - t0, latency_s=now - req.submit_s,
                 batch=1, padded_batch=1, occupancy=1.0,
                 cache_hit=False, builds=0, retries=retries,
                 degraded=True, deadline_missed=missed,
-                priority=req.priority, tenant=req.tenant))
+                priority=req.priority, tenant=req.tenant, legs=legs))
             self._latencies.append(now - req.submit_s)
             self._note_class_terminal(req, now - req.submit_s, missed)
             self._completed += 1
@@ -958,6 +1214,28 @@ class FleetService:
         self.n_devices = (int(self.mesh.devices.size)
                           if self.mesh is not None else 1)
         self.cache.rebind_mesh(self.mesh)
+        self._failures["mesh_rebuilds"] += 1
+
+    def _grow_mesh(self) -> None:
+        """One rung UP the ladder (PR 8): re-extend the lane mesh
+        toward the full-strength device set captured at construction
+        (``parallel.fleet_mesh.grow_mesh``) and re-key the program
+        cache — a descriptor that served before the loss finds its
+        retained handles and compiled programs warm (``rebind_mesh``
+        re-keys rather than evicts), so a shrink -> grow cycle costs
+        zero rebuilds.  Queued and checkpointed lanes migrate onto the
+        wider mesh at their next dispatch (the snapshots are
+        mesh-independent host numpy).  No-op on a service that never
+        had a mesh, or one already at full strength."""
+        from ..parallel.fleet_mesh import grow_mesh
+        new = grow_mesh(self.mesh, self._full_devices)
+        new_d = int(new.devices.size) if new is not None else 1
+        if new is self.mesh or new_d == self.n_devices:
+            return
+        self.mesh = new
+        self.n_devices = new_d
+        self.cache.rebind_mesh(new)
+        self._elastic["mesh_grows"] += 1
         self._failures["mesh_rebuilds"] += 1
 
     def _fail_request(self, req, error: BaseException,
@@ -1063,19 +1341,42 @@ class FleetService:
         self._filler.setdefault(key, cfg)
         self._bucket_stats.setdefault(key, {"requests": 0, "dispatches": 0,
                                             "builds": 0})
-        padded = pad_configs([cfg], self._width(self.capacity), cfg)
+        width = self._width(self.capacity)
+        padded = pad_configs([cfg], width, cfg)
         builds0 = run_build_count()
-        if mode == "bench":
-            res = sim.run_bench(configs=padded, warmup=False, n_real=1)
+        first_leg = None
+        if self.checkpoint_every is not None \
+                and (cfg.model == "overlay" or mode == "trace"):
+            end0 = cut_for_budget(cfg, 0, self.checkpoint_every)
+            if end0 < cfg.total_ticks:
+                first_leg = end0
+        if first_leg is not None:
+            # checkpointed serving dispatches LEG-length programs, not
+            # the monolithic whole-run one — warm the same leg chain
+            # the scheduler will run (one program per distinct leg
+            # length), so elastic dispatches don't compile in-band
+            leg = sim.run_leg(configs=padded, n_real=1,
+                              ticks=first_leg, mode=mode)
+            while not leg.done:
+                nxt = cut_for_budget(cfg, leg.checkpoints[0].tick,
+                                     self.checkpoint_every)
+                leg = sim.run_leg(resume=leg.checkpoints,
+                                  ticks=nxt - leg.checkpoints[0].tick,
+                                  width=width)
+            wall = float(leg.checkpoints[0].wall_seconds)
+        elif mode == "bench":
+            wall = float(sim.run_bench(configs=padded, warmup=False,
+                                       n_real=1).wall_seconds)
         else:
-            res = sim.run(configs=padded, n_real=1, warmup=False)
+            wall = float(sim.run(configs=padded, n_real=1,
+                                 warmup=False).wall_seconds)
         self._bucket_stats[key]["builds"] += run_build_count() - builds0
         # seed the bucket's dispatch-wall EWMA so the SLO early-flush
         # estimate has a real number before the first live dispatch.
         # A warm run that just compiled reports an inflated wall —
         # which errs CONSERVATIVE (flush earlier than strictly needed)
         # and the EWMA converges within a few live dispatches
-        self._bucket_wall.setdefault(key, float(res.wall_seconds))
+        self._bucket_wall.setdefault(key, wall)
 
     def stats(self) -> dict:
         """Service-level serving metrics (the BENCH json schema).
@@ -1158,6 +1459,12 @@ class FleetService:
             # dispatches and per-tenant admission shedding
             "slo_early_flushes": self._early_flushes,
             "tenant_shed": dict(sorted(self._tenant_shed.items())),
+            # the elasticity plane (PR 8): mesh grows, segment-
+            # boundary checkpoints, lane migrations across mesh
+            # rebuilds, resume dispatches, and the restarted-from-
+            # tick-0 counter the elastic replay gate pins to 0
+            "elastic": dict(self._elastic),
+            "checkpoint_every": self.checkpoint_every,
         }
         # per-priority-class view: each class's OWN windowed
         # percentiles + lifetime terminal counters (completed counts

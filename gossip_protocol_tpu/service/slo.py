@@ -81,6 +81,15 @@ class SLOPolicy:
         default_factory=lambda: {"standard": ClassPolicy()})
     default_class: str = "standard"
     early_flush: bool = True
+    #: deadline-aware DISPATCH ORDERING (PR 8 satellite): ``pump()``
+    #: pops the bucket holding the tightest queued deadline first
+    #: instead of FIFO over bucket creation order — through PR 7
+    #: classes shaped deadlines but not which bucket dispatched first,
+    #: so a tight-deadline batch could sit behind a deadline-less one
+    #: for a whole dispatch wall.  Deterministic (ties break on bucket
+    #: creation order), so virtual-clock replays stay digest-stable.
+    #: ``False`` is the A/B leg (loadbench.slo_ab ``ordering_ab``).
+    class_ordering: bool = True
     #: the dispatch-wall estimate is multiplied by this before being
     #: compared against the deadline margin — headroom for the
     #: estimate being an EWMA of a noisy wall

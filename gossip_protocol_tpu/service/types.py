@@ -22,7 +22,13 @@ MODES = ("trace", "bench")
 
 @dataclass
 class SimRequest:
-    """One admitted simulation request (immutable once queued)."""
+    """One admitted simulation request.
+
+    Immutable once queued, with one scheduler-owned exception: under
+    checkpointed serving (PR 8) the scheduler advances ``resume`` (the
+    lane's latest snapshot) and ``bucket`` (the resume sub-bucket the
+    request re-queues under) as legs complete.
+    """
 
     rid: int              # service-assigned id, submission order
     cfg: SimConfig        # the lane's full config (seed included)
@@ -42,6 +48,14 @@ class SimRequest:
     #: tenant attribution for per-tenant admission quotas and shed
     #: accounting (None: untenanted — never quota-limited)
     tenant: Optional[str] = None
+    #: the lane's latest segment-boundary checkpoint
+    #: (core/fleet.LaneCheckpoint) when the request runs as resumable
+    #: legs (PR 8 elastic serving, ``FleetService(checkpoint_every=)``).
+    #: Set by the scheduler when a non-final leg resolves; the request
+    #: then re-queues under a resume sub-bucket and its next dispatch
+    #: re-enters the scan from this snapshot — never from tick 0.
+    #: Cleared at completion.
+    resume: Optional[object] = None
 
 
 @dataclass
@@ -81,6 +95,11 @@ class RequestMetrics:
     #: per-class/per-tenant analysis needs only the metrics stream
     priority: str = "default"
     tenant: Optional[str] = None
+    #: dispatches this request rode to completion: 1 on the monolithic
+    #: path; the number of resumable legs under checkpointed serving
+    #: (PR 8) — each leg re-entered the scan from the previous leg's
+    #: segment-boundary snapshot
+    legs: int = 1
 
 
 @dataclass
@@ -139,19 +158,34 @@ class RequestHandle:
         return self._error
 
     def result(self):
-        if not self.done:
-            self._service.flush(self.request.bucket)
+        # under checkpointed serving (PR 8) a flush of the request's
+        # bucket may CHECKPOINT its batch and re-queue it under the
+        # next leg's resume sub-bucket (request.bucket is updated in
+        # place) — keep flushing the request's CURRENT bucket until it
+        # is terminal; each flush advances the run by at least one
+        # leg, so zero dispatches without a terminal state means the
+        # flush was interrupted
+        while not self.done:
+            bucket = self.request.bucket
+            n = self._service.flush(bucket)
+            if self.done:
+                break
+            if n == 0 and self.request.bucket == bucket:
+                # a flush can legitimately dispatch NOTHING yet still
+                # advance this request: resolving an in-flight
+                # pipelined leg checkpoints the batch and re-queues it
+                # one cut further (request.bucket moves) — only a
+                # zero-dispatch flush that left the request in the
+                # SAME bucket is stuck.  Unreachable through the
+                # scheduler's atomic dispatch path; kept as a guard
+                # against interrupted flushes (KeyboardInterrupt
+                # re-queues the batch and propagates)
+                raise RuntimeError(
+                    f"request {self.request.rid} is still pending "
+                    "after a flush of its bucket; the flush was "
+                    "interrupted — flush again")
         if self._error is not None:
             raise self._error
-        if not self.done:
-            # unreachable through the scheduler's atomic dispatch path
-            # (every popped request is terminally resolved); kept as a
-            # guard against interrupted flushes (KeyboardInterrupt
-            # re-queues the batch and propagates)
-            raise RuntimeError(
-                f"request {self.request.rid} is still pending after a "
-                "flush of its bucket; the flush was interrupted — "
-                "flush again")
         return self._result
 
     @property

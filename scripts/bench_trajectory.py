@@ -87,6 +87,9 @@ def load_rows():
         replay = _best_replay(sec)
         chaos = (_get(sec, "service_replay_chaos_204req")
                  or _get(sec, "service_replay_chaos") or {})
+        # elastic serving entry (PR 8+): loss+return replay as
+        # resumable legs; absent in earlier PRs' jsons -> "-"
+        elastic = sec.get("service_replay_elastic") or {}
         # open-loop load entry (PR 7+): absent in earlier PRs' jsons —
         # every field defaults to None and renders as "-"
         load = sec.get("service_load_openloop") or {}
@@ -104,6 +107,9 @@ def load_rows():
             "replay_source": replay[4] if replay else None,
             "chaos_completion": chaos.get("completion_rate"),
             "chaos_speedup": chaos.get("speedup_vs_sequential"),
+            "elastic_completion": elastic.get("completion_rate"),
+            "elastic_restarted": elastic.get("restarted_from_zero"),
+            "elastic_mean_legs": elastic.get("mean_legs"),
             "load_saturation_rps": load.get("saturation_offered_rps"),
             "load_max_achieved_rps": load.get("max_achieved_rps"),
             "load_miss_rate_slo_on": load_miss,
@@ -137,6 +143,8 @@ def main(argv) -> int:
             ("p95 s", "replay_p95_s", "{:.2f}"),
             ("dev-frac", "replay_device_wait_frac", "{:.2f}"),
             ("chaos", "chaos_completion", "{:.0%}"),
+            ("elastic", "elastic_completion", "{:.0%}"),
+            ("legs", "elastic_mean_legs", "{:.1f}"),
             ("load rps", "load_max_achieved_rps", "{:.1f}"),
             ("sat rps", "load_saturation_rps", "{:.1f}")]
     table = [[_fmt(r.get(key), spec) for _, key, spec in cols]

@@ -12,6 +12,20 @@ Modes:
     python scripts/service_smoke.py chaos [34] [0.12] # seeded fault sweep
     python scripts/service_smoke.py pipeline [34]     # pipelined vs sync per D
     python scripts/service_smoke.py load [24]         # open-loop 3-seed sweep
+    python scripts/service_smoke.py elastic [34] [48] # loss+return legs sweep
+
+``elastic`` (PR 8) exercises the elasticity ladder end to end
+(docs/SERVING.md "Elastic capacity"): for each of three fault seeds
+the acceptance stream is served as RESUMABLE LEGS
+(``checkpoint_every`` segment budget, second arg) from a 2-device
+lane mesh with ONE seeded device loss and ONE device return —
+shrink, migrate the checkpointed lanes, grow back, migrate again.
+Gates (all enforced inside service.elastic_replay): 100% terminal
+handles, >= 1 loss AND >= 1 return actually injected, ZERO lanes
+restarted from tick 0 (every interrupted lane resumes from its last
+segment-boundary checkpoint), per-request bit-parity against solo
+runs, and the first seed re-run digest-for-digest (fault schedule +
+per-request status/retries/legs).
 
 ``load`` (PR 7) exercises the open-loop traffic plane
 (service/traffic.py + service/slo.py + service/loadbench.py): for
@@ -71,7 +85,8 @@ import json
 import os
 import sys
 
-if sys.argv[1:2] and sys.argv[1] in ("mesh", "chaos", "pipeline"):
+if sys.argv[1:2] and sys.argv[1] in ("mesh", "chaos", "pipeline",
+                                     "elastic"):
     # virtual devices must be forced before jax is first imported
     os.environ["JAX_PLATFORMS"] = "cpu"
     _flags = os.environ.get("XLA_FLAGS", "")
@@ -237,6 +252,59 @@ def main(argv) -> int:
         print(f"acceptance: completion=100% "
               f"{'OK' if all(r['completion_rate'] == 1.0 for r in rows) else 'FAIL'}, "
               f"0 stranded OK (enforced), parity OK (enforced), "
+              f"seed replay {'OK' if reproduced else 'FAIL'} "
+              f"(schedule {m2['schedule_digest']}, "
+              f"outcomes {m2['outcome_digest']})", flush=True)
+        return 0 if ok else 1
+    elif mode == "elastic":
+        from gossip_protocol_tpu.parallel.fleet_mesh import make_lane_mesh
+        from gossip_protocol_tpu.service import elastic_replay
+        seeds = int(argv[1]) if len(argv) > 1 else 34
+        every = int(argv[2]) if len(argv) > 2 else 48
+        mesh_d = 2 if jax.device_count() >= 2 else 1
+        tpls = _templates(512, 96)
+        print(f"elastic sweep: {seeds * len(tpls)} requests/seed, "
+              f"checkpoint_every={every}, mesh D={mesh_d}, one device "
+              "loss + one device return", flush=True)
+        seq = None
+        rows = []
+        for fseed in (7, 19, 23):
+            mesh = make_lane_mesh(mesh_d) if mesh_d > 1 else None
+            kw = dict(seeds_per_template=seeds, max_batch=8 // mesh_d,
+                      mesh=mesh, checkpoint_every=every,
+                      fault_seed=fseed)
+            if seq is None:
+                m, seq = elastic_replay(tpls, return_legs=True, **kw)
+            else:
+                m = elastic_replay(tpls, sequential=seq, **kw)
+            rows.append(m)
+            el = m["elastic"]
+            print(f"seed={fseed:3d}: loss@{m['device_loss_at']} "
+                  f"return@{m['device_return_at']}, completed "
+                  f"{m['completed']}/{m['requests']}, mean legs "
+                  f"{m['mean_legs']:.2f}, checkpoints "
+                  f"{el['checkpoints_taken']}, migrated "
+                  f"{el['lanes_migrated']}, grows {el['mesh_grows']}, "
+                  f"restarted {el['restarted_lanes']}, rekey hits "
+                  f"{m['cache_rekey_hits']}, devices "
+                  f"{m['devices_start']}->{m['devices_end']}, "
+                  f"{m['speedup_vs_sequential']:.2f}x sequential",
+                  flush=True)
+        mesh = make_lane_mesh(mesh_d) if mesh_d > 1 else None
+        m2 = elastic_replay(tpls, seeds_per_template=seeds,
+                            max_batch=8 // mesh_d, mesh=mesh,
+                            checkpoint_every=every, fault_seed=7,
+                            sequential=seq)
+        reproduced = (m2["schedule_digest"] == rows[0]["schedule_digest"]
+                      and m2["outcome_digest"] == rows[0]["outcome_digest"])
+        zero_restart = all(r["restarted_from_zero"] == 0 for r in rows)
+        ok = (all(r["completion_rate"] == 1.0 for r in rows)
+              and zero_restart and reproduced)
+        print(f"acceptance: completion=100% "
+              f"{'OK' if all(r['completion_rate'] == 1.0 for r in rows) else 'FAIL'}, "
+              f"zero restarted-from-zero "
+              f"{'OK' if zero_restart else 'FAIL'}, loss+return "
+              "injected OK (enforced), parity OK (enforced), "
               f"seed replay {'OK' if reproduced else 'FAIL'} "
               f"(schedule {m2['schedule_digest']}, "
               f"outcomes {m2['outcome_digest']})", flush=True)

@@ -1,0 +1,462 @@
+"""Elastic serving (PR 8): segment-boundary checkpointing, mesh grow,
+and in-flight lane migration.
+
+The contracts under test:
+
+* **snapshot exactness** — a fleet run split at ANY segment cut
+  (core/fleet.py ``launch_leg``: stacked carry snapshotted to host
+  numpy, re-entered from the cut) is bit-identical to the
+  uninterrupted run — dense and overlay, single-device and lane-mesh;
+* **snapshot discipline** — the PR-1 planner's cuts are the ONLY
+  legal leg boundaries (phase elision stays static across a resume);
+* **never restart from tick 0** — a device loss (or any dispatch
+  failure) mid-sequence retries a checkpointed batch from its LAST
+  snapshot, and even the solo-degrade bottom rung resumes
+  (``solo_resume``); the scheduler's ``restarted_lanes`` counter
+  stays 0;
+* **the grow ladder** — a deterministic fault-plane device return
+  grows the mesh back (``grow_mesh``), the program cache RE-KEYS to
+  the restored mesh's warm programs (zero rebuilds), and queued +
+  checkpointed lanes migrate across the rebuild;
+* **replayability** — a shrink -> grow -> shrink chaos seed
+  reproduces its fault schedule and per-request outcomes (status,
+  retries, legs) digest-for-digest.
+"""
+
+import numpy as np
+import pytest
+
+from gossip_protocol_tpu.config import SimConfig
+from gossip_protocol_tpu.core.fleet import FleetSimulation
+from gossip_protocol_tpu.models.segments import (CHECKPOINT_GRID_TICKS,
+                                                 checkpoint_ticks,
+                                                 cut_for_budget)
+from gossip_protocol_tpu.service import (BreakerPolicy, FaultInjector,
+                                         FleetService, RetryPolicy)
+from gossip_protocol_tpu.service.resilience import solo_execute
+
+pytestmark = [pytest.mark.service, pytest.mark.resilience]
+
+
+def _overlay_churn_drop(n=64, ticks=96):
+    """Overlay churn + drop10: every protocol phase (ramp, churn,
+    join, drop) crosses at least one segment cut."""
+    return SimConfig(max_nnb=n, model="overlay", single_failure=False,
+                     drop_msg=True, msg_drop_prob=0.1, seed=0,
+                     total_ticks=ticks, churn_rate=0.2, rejoin_after=30,
+                     step_rate=12 / n, drop_open_tick=ticks // 3,
+                     drop_close_tick=2 * ticks // 3)
+
+
+def _dense_churn_drop(n=16, ticks=60):
+    return SimConfig(max_nnb=n, single_failure=False, drop_msg=True,
+                     msg_drop_prob=0.1, seed=0, total_ticks=ticks,
+                     fail_tick=30, rejoin_after=15, drop_open_tick=10,
+                     drop_close_tick=50)
+
+
+def _fast_retry(**kw):
+    kw.setdefault("max_retries", 3)
+    kw.setdefault("backoff_base_s", 1e-4)
+    return RetryPolicy(**kw)
+
+
+def _assert_overlay_equal(ref, got, tag=""):
+    for f in ("tick", "ids", "hb", "ts", "in_group", "own_hb",
+              "send_flags", "joinreq", "joinrep"):
+        assert np.array_equal(np.asarray(getattr(ref.final_state, f)),
+                              np.asarray(getattr(got.final_state, f))), \
+            f"{tag} final_state.{f}"
+    for f in ("in_group", "view_slots", "adds", "removals",
+              "false_removals", "victim_slots", "sent", "recv"):
+        assert np.array_equal(np.asarray(getattr(ref.metrics, f)),
+                              np.asarray(getattr(got.metrics, f))), \
+            f"{tag} metrics.{f}"
+
+
+def _assert_dense_equal(ref, got, tag=""):
+    for f in ("added", "removed", "sent", "recv"):
+        assert np.array_equal(getattr(ref, f), getattr(got, f)), \
+            f"{tag} {f}"
+    for f in ("tick", "in_group", "own_hb", "known", "hb", "ts",
+              "gossip", "joinreq", "joinrep"):
+        assert np.array_equal(np.asarray(getattr(ref.final_state, f)),
+                              np.asarray(getattr(got.final_state, f))), \
+            f"{tag} final_state.{f}"
+
+
+# ---- the snapshot planner --------------------------------------------
+def test_checkpoint_grid_quantum_matches_kernel():
+    """The planner's launch quantum and the grid kernel's GRID_TICKS
+    are the same constant (segments.py cannot import the Pallas stack,
+    so the sync is pinned here)."""
+    from gossip_protocol_tpu.ops.pallas.overlay_grid import GRID_TICKS
+    assert CHECKPOINT_GRID_TICKS == GRID_TICKS
+
+
+def test_cut_for_budget_rules():
+    cfg = _dense_churn_drop()                    # cuts (16, 48) of 60
+    assert checkpoint_ticks(cfg) == (16, 48)
+    assert cut_for_budget(cfg, 0, 100) == 60     # fits: finish
+    assert cut_for_budget(cfg, 0, 20) == 16      # largest cut in budget
+    assert cut_for_budget(cfg, 0, 50) == 48
+    assert cut_for_budget(cfg, 16, 8) == 48      # none in budget: next
+    assert cut_for_budget(cfg, 48, 8) == 60      # no cuts left: finish
+    with pytest.raises(ValueError, match="outside"):
+        cut_for_budget(cfg, 60, 8)
+
+
+def test_leg_boundaries_enforced():
+    """Only segment cuts (or the run's end) are legal leg boundaries,
+    and resumed lanes must agree on the shared scan clock."""
+    cfg = _dense_churn_drop()
+    sim = FleetSimulation(cfg)
+    cfgs = [cfg.replace(seed=s) for s in (1, 2)]
+    with pytest.raises(ValueError, match="segment cut"):
+        sim.run_leg(configs=cfgs, ticks=20)      # 20 is mid-segment
+    leg16 = sim.run_leg(configs=cfgs, ticks=16)
+    leg48 = sim.run_leg(resume=leg16.checkpoints, ticks=32)
+    with pytest.raises(ValueError, match="clock"):
+        sim.run_leg(resume=[leg16.checkpoints[0], leg48.checkpoints[1]])
+
+
+# ---- checkpoint/resume bit-parity ------------------------------------
+def test_overlay_resume_bit_identical_at_every_cut():
+    """Satellite gate: resuming at EVERY segment boundary of a
+    churn+drop10 overlay config reproduces the uninterrupted fleet run
+    bit-for-bit — including through a padded (filler-lane) leg."""
+    cfg = _overlay_churn_drop()
+    cuts = checkpoint_ticks(cfg)
+    assert len(cuts) >= 2, cuts
+    cfgs = [cfg.replace(seed=s) for s in (1, 2, 3)]
+    sim = FleetSimulation(cfg)
+    full = sim.run(configs=cfgs, warmup=False)
+    for cut in cuts:
+        leg = sim.run_leg(configs=cfgs + [cfg.replace(seed=9)],
+                          n_real=3, ticks=cut)
+        assert leg.checkpoints[0].tick == cut
+        leg = sim.run_leg(resume=leg.checkpoints, width=4)
+        assert leg.done
+        res = leg.results()
+        for ref, got in zip(full.lanes, res.lanes):
+            _assert_overlay_equal(ref, got, tag=f"cut={cut}")
+
+
+def test_dense_resume_bit_identical_at_every_cut():
+    cfg = _dense_churn_drop()
+    cfgs = [cfg.replace(seed=s) for s in (1, 2)]
+    sim = FleetSimulation(cfg)
+    full = sim.run(configs=cfgs, warmup=False)
+    for cut in checkpoint_ticks(cfg):
+        leg = sim.run_leg(configs=cfgs, ticks=cut)
+        leg = sim.run_leg(resume=leg.checkpoints)
+        assert leg.done
+        res = leg.results()
+        for ref, got in zip(full.lanes, res.lanes):
+            _assert_dense_equal(ref, got, tag=f"cut={cut}")
+
+
+@pytest.mark.skipif(__import__("jax").device_count() < 2,
+                    reason="needs 2 (virtual) devices")
+def test_mesh_leg_resume_and_cross_mesh_migration():
+    """A checkpoint is mesh-independent: a leg run on a D=2 mesh can
+    be resumed on a single device (and vice versa), bit-identical to
+    the uninterrupted single-device fleet."""
+    from gossip_protocol_tpu.parallel.fleet_mesh import (
+        MeshFleetSimulation, make_lane_mesh)
+    cfg = _overlay_churn_drop()
+    cut = checkpoint_ticks(cfg)[0]
+    cfgs = [cfg.replace(seed=s) for s in (1, 2, 3, 4)]
+    full = FleetSimulation(cfg).run(configs=cfgs, warmup=False)
+    msim = MeshFleetSimulation(cfg, make_lane_mesh(2))
+    leg = msim.run_leg(configs=cfgs, ticks=cut)        # D=2 leg
+    leg = FleetSimulation(cfg).run_leg(resume=leg.checkpoints)  # D=1
+    res = leg.results()
+    for ref, got in zip(full.lanes, res.lanes):
+        _assert_overlay_equal(ref, got, tag="mesh->solo")
+    # and the other direction: solo leg, mesh resume
+    leg = FleetSimulation(cfg).run_leg(configs=cfgs, ticks=cut)
+    leg = msim.run_leg(resume=leg.checkpoints)
+    for ref, got in zip(full.lanes, leg.results().lanes):
+        _assert_overlay_equal(ref, got, tag="solo->mesh")
+
+
+# ---- checkpointed serving --------------------------------------------
+def test_service_checkpointed_serving_parity_and_counters():
+    """FleetService(checkpoint_every=) serves long dispatches as
+    resumable legs: results stay bit-identical to solo runs, handles
+    report the leg count, and the elasticity counters move."""
+    ov = _overlay_churn_drop()
+    dn = _dense_churn_drop()
+    svc = FleetService(max_batch=3, checkpoint_every=16)
+    hs = [svc.submit(ov, seed=s) for s in (1, 2)] \
+        + [svc.submit(dn, seed=s) for s in (1, 2)]
+    svc.drain()
+    assert all(h.status == "completed" for h in hs)
+    assert all(h.metrics.legs >= 2 for h in hs), \
+        [h.metrics.legs for h in hs]
+    st = svc.stats()
+    assert st["elastic"]["checkpoints_taken"] >= 2
+    assert st["elastic"]["resume_dispatches"] >= 2
+    assert st["elastic"]["restarted_lanes"] == 0
+    assert st["checkpoint_every"] == 16
+    for h in hs:
+        ref = solo_execute(h.request.cfg, h.request.mode)
+        if h.request.cfg.model == "overlay":
+            _assert_overlay_equal(ref, h.result())
+        else:
+            _assert_dense_equal(ref, h.result())
+    # result() on a checkpointed request flushes leg by leg
+    svc2 = FleetService(max_batch=2, checkpoint_every=16)
+    h = svc2.submit(ov, seed=5)
+    ref = solo_execute(ov.replace(seed=5), "trace")
+    _assert_overlay_equal(ref, h.result())
+    assert h.metrics.legs >= 2
+
+
+def test_result_advances_pipelined_checkpointed_leg():
+    """Review regression: under the default pipelined beat, a full
+    bucket dispatched by ``submit``'s pump leaves leg 1 IN FLIGHT;
+    ``result()`` must then walk the whole leg chain — the first flush
+    dispatches nothing (the queue is empty) but resolving the
+    in-flight leg checkpoints and re-queues the batch, which is
+    progress, not an interrupted flush."""
+    ov = _overlay_churn_drop()
+    svc = FleetService(max_batch=2, checkpoint_every=16)
+    hs = [svc.submit(ov, seed=s) for s in (1, 2)]
+    assert svc.in_flight == 2            # leg 1 launched, unresolved
+    _assert_overlay_equal(solo_execute(ov.replace(seed=1), "trace"),
+                          hs[0].result())
+    _assert_overlay_equal(solo_execute(ov.replace(seed=2), "trace"),
+                          hs[1].result())
+    assert all(h.metrics.legs >= 2 for h in hs)
+
+
+def test_device_loss_mid_sequence_resumes_from_checkpoint():
+    """A device loss hitting a RESUME dispatch retries from the last
+    checkpoint — never from tick 0 — and the batch completes
+    bit-identically."""
+    ov = _overlay_churn_drop()
+    svc = FleetService(max_batch=2, checkpoint_every=16,
+                       injector=FaultInjector(device_loss_at=2),
+                       retry=_fast_retry())
+    hs = [svc.submit(ov, seed=s) for s in (1, 2)]
+    svc.drain()
+    assert [h.status for h in hs] == ["completed", "completed"]
+    st = svc.stats()
+    assert st["failures"]["device_losses"] == 1
+    assert st["failures"]["retries"] >= 1
+    assert st["elastic"]["restarted_lanes"] == 0
+    for s, h in zip((1, 2), hs):
+        _assert_overlay_equal(solo_execute(ov.replace(seed=s), "trace"),
+                              h.result())
+
+
+def test_solo_degrade_resumes_from_checkpoint():
+    """Even the ladder's bottom rung preserves checkpointed work: a
+    resumed leg that exhausts its retries is served by solo_resume
+    (continuation from the snapshot), not a tick-0 re-run — and the
+    stitched result is still bit-identical to an uninterrupted solo
+    run."""
+    ov = _overlay_churn_drop()
+    svc = FleetService(
+        max_batch=2, checkpoint_every=16,
+        injector=FaultInjector(schedule={2: "dispatch", 3: "dispatch"}),
+        retry=_fast_retry(max_retries=1),
+        breaker=BreakerPolicy(failure_threshold=10))
+    hs = [svc.submit(ov, seed=s) for s in (1, 2)]
+    svc.drain()
+    assert [h.status for h in hs] == ["degraded", "degraded"]
+    assert all(h.metrics.legs >= 2 for h in hs)
+    assert svc.stats()["elastic"]["restarted_lanes"] == 0
+    for s, h in zip((1, 2), hs):
+        _assert_overlay_equal(solo_execute(ov.replace(seed=s), "trace"),
+                              h.result())
+
+
+# ---- the grow ladder -------------------------------------------------
+@pytest.mark.skipif(__import__("jax").device_count() < 2,
+                    reason="needs 2 (virtual) devices")
+def test_device_return_grows_mesh_migrates_lanes_and_rekeys():
+    """The elastic round trip: loss shrinks D=2 -> single device
+    (checkpointed lanes migrate down), a fault-plane device return
+    grows it back (lanes migrate up), the program cache RE-KEYS to the
+    restored mesh's warm programs, and every result stays
+    bit-identical."""
+    from gossip_protocol_tpu.core.tick import run_build_count
+    from gossip_protocol_tpu.parallel.fleet_mesh import make_lane_mesh
+    ov = _overlay_churn_drop()
+    svc = FleetService(max_batch=2, mesh=make_lane_mesh(2),
+                       checkpoint_every=16,
+                       injector=FaultInjector(device_loss_at=2,
+                                              device_return_at=4),
+                       retry=_fast_retry(),
+                       breaker=BreakerPolicy(reset_after_s=float("inf")))
+    hs = [svc.submit(ov, seed=s) for s in (1, 2, 3, 4)]
+    # the first leg dispatched on D=2; warm the count AFTER it exists
+    svc.pump()
+    svc.drain()
+    assert all(h.status == "completed" for h in hs)
+    st = svc.stats()
+    assert st["failures"]["device_losses"] == 1
+    assert st["failures"]["device_returns"] == 1
+    assert st["elastic"]["mesh_grows"] == 1
+    assert st["elastic"]["lanes_migrated"] >= 8   # down AND back up
+    assert st["elastic"]["restarted_lanes"] == 0
+    assert st["devices"] == 2 and svc.n_devices == 2
+    assert st["cache"]["rekey_hits"] >= 1
+    for s, h in zip((1, 2, 3, 4), hs):
+        _assert_overlay_equal(solo_execute(ov.replace(seed=s), "trace"),
+                              h.result())
+    # the grow re-keyed to the original D=2 programs: a fresh dispatch
+    # on the restored mesh builds NOTHING new
+    built = run_build_count()
+    h2 = [svc.submit(ov, seed=s) for s in (5, 6, 7, 8)]
+    svc.drain()
+    assert run_build_count() == built, \
+        "the restored mesh recompiled instead of re-keying"
+    assert all(h.status == "completed" for h in h2)
+
+
+def test_grow_mesh_ladder():
+    import jax
+    from gossip_protocol_tpu.parallel.fleet_mesh import (grow_mesh,
+                                                         make_lane_mesh,
+                                                         mesh_descriptor,
+                                                         shrink_mesh)
+    assert grow_mesh(None, None) is None         # never had a mesh
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 (virtual) devices")
+    m4 = make_lane_mesh(4)
+    full = tuple(m4.devices.flat)
+    m3 = shrink_mesh(m4)
+    assert mesh_descriptor(grow_mesh(m3, full)) == mesh_descriptor(m4)
+    m2 = shrink_mesh(m3)
+    none = shrink_mesh(m2)
+    assert none is None
+    g2 = grow_mesh(none, full)                   # None -> 2-device mesh
+    assert mesh_descriptor(g2) == mesh_descriptor(m2)
+    assert grow_mesh(m4, full) is m4             # already full
+
+
+@pytest.mark.skipif(__import__("jax").device_count() < 2,
+                    reason="needs 2 (virtual) devices")
+def test_shrink_grow_shrink_chaos_seed_replays_digest_for_digest():
+    """Satellite gate: a shrink -> grow -> shrink chaos sequence
+    reproduces its fault schedule AND per-request outcomes (status,
+    retries, legs) across two runs, with zero restarted-from-zero
+    lanes in both."""
+    from gossip_protocol_tpu.parallel.fleet_mesh import make_lane_mesh
+    ov = _overlay_churn_drop()
+
+    def run_once():
+        inj = FaultInjector(seed=11, schedule={2: "device_loss",
+                                               4: "device_return",
+                                               6: "device_loss"})
+        svc = FleetService(max_batch=2, mesh=make_lane_mesh(2),
+                           checkpoint_every=16, injector=inj,
+                           retry=_fast_retry(),
+                           breaker=BreakerPolicy(
+                               reset_after_s=float("inf")))
+        hs = [svc.submit(ov, seed=s) for s in (1, 2, 3, 4)]
+        svc.drain()
+        st = svc.stats()
+        assert st["elastic"]["restarted_lanes"] == 0
+        return (inj.schedule_digest(), st["devices"],
+                tuple((h.request.rid, h.status, h.metrics.retries,
+                       h.metrics.legs) for h in hs))
+
+    a, b = run_once(), run_once()
+    assert a == b
+    digest, devices, outcomes = a
+    assert devices == 1            # the second loss is never reclaimed
+    assert all(o[1] == "completed" for o in outcomes)
+
+
+# ---- SLO class dispatch ordering (PR 7 follow-on) --------------------
+def test_pump_pops_tight_deadline_class_first():
+    """Classes now shape DISPATCH ORDER, not just deadlines: with
+    class_ordering (the default) a pump pass serves the bucket holding
+    the tightest queued deadline first; with it off, FIFO over bucket
+    creation order — the pre-PR-8 behavior."""
+    from gossip_protocol_tpu.service import (ClassPolicy, SLOPolicy,
+                                             VirtualClock)
+    dn = _dense_churn_drop(n=12, ticks=20)
+    ov = _overlay_churn_drop(n=64, ticks=48)
+    slo = SLOPolicy(classes={"interactive": ClassPolicy(deadline_s=30.0),
+                             "batch": ClassPolicy(deadline_s=None)},
+                    default_class="batch",
+                    assumed_dispatch_wall_s=0.01)
+
+    def dispatch_order(ordering: bool):
+        import dataclasses
+        vc = VirtualClock()
+        svc = FleetService(
+            max_batch=2, max_wait_s=5.0, clock=vc, sleep=vc.sleep,
+            slo=dataclasses.replace(slo, class_ordering=ordering),
+            pump_harvest=False)
+        # the deadline-less bucket enqueues FIRST, then the
+        # tight-deadline one; neither flushes at t=0 (margins are
+        # ample).  At t=6 BOTH are past max_wait: the pump pass's
+        # bucket order is the decision under test.
+        svc.submit(ov, seed=1, priority="batch")
+        svc.submit(dn, seed=1, priority="interactive")
+        vc.t = 6.0
+        svc.pump()
+        svc.drain()
+        order = [d["bucket"][1][0] for d in svc._dispatches]
+        return order
+
+    assert dispatch_order(True)[0] == "full_view"    # tight class first
+    assert dispatch_order(False)[0] == "overlay"     # FIFO
+
+
+# ---- the acceptance harness ------------------------------------------
+def test_elastic_replay_small():
+    """The in-line gates of elastic_replay on a small stream: 100%
+    completion, >=1 loss + >=1 return, zero restarted lanes, lane
+    migration across the rebuild, digest-for-digest replay."""
+    import jax
+    if jax.device_count() < 2:
+        pytest.skip("needs 2 (virtual) devices")
+    from gossip_protocol_tpu.parallel.fleet_mesh import make_lane_mesh
+    from gossip_protocol_tpu.service import (Template, elastic_replay,
+                                             overlay_templates)
+    tpls = [Template("churn-drop", _overlay_churn_drop())] \
+        + overlay_templates(n=64, ticks=96)[:1]
+    m, seq = elastic_replay(tpls, seeds_per_template=2, max_batch=2,
+                            mesh=make_lane_mesh(2), checkpoint_every=32,
+                            fault_seed=7, return_legs=True)
+    assert m["completion_rate"] == 1.0
+    assert m["faults"]["device_loss"] >= 1
+    assert m["faults"]["device_return"] >= 1
+    assert m["restarted_from_zero"] == 0
+    assert m["elastic"]["lanes_migrated"] >= 1
+    assert m["devices_end"] == m["devices_start"] == 2
+    assert m["mean_legs"] > 1.0
+    m2 = elastic_replay(tpls, seeds_per_template=2, max_batch=2,
+                        mesh=make_lane_mesh(2), checkpoint_every=32,
+                        fault_seed=7, sequential=seq)
+    assert m2["schedule_digest"] == m["schedule_digest"]
+    assert m2["outcome_digest"] == m["outcome_digest"]
+
+
+@pytest.mark.slow
+def test_elastic_acceptance():
+    """The full elastic chaos gate (the BENCH_pr08 entry's shape): the
+    204-request mixed replay as resumable legs on a D=2 mesh with one
+    device loss AND one device return — 204/204, zero restarts, parity,
+    digest-replayable (all enforced inside elastic_replay)."""
+    import jax
+    if jax.device_count() < 2:
+        pytest.skip("needs 2 (virtual) devices")
+    from gossip_protocol_tpu.parallel.fleet_mesh import make_lane_mesh
+    from gossip_protocol_tpu.service import (elastic_replay,
+                                             grader_templates,
+                                             overlay_templates)
+    tpls = grader_templates() + overlay_templates(n=512, ticks=96)
+    m = elastic_replay(tpls, seeds_per_template=34, max_batch=4,
+                       mesh=make_lane_mesh(2), checkpoint_every=48,
+                       fault_seed=20260804)
+    assert m["requests"] == 204 and m["completed"] == 204
+    assert m["restarted_from_zero"] == 0
