@@ -91,6 +91,149 @@ class SimConfig:
     #: normal JOINREQ path.  None disables (reference behavior).
     rejoin_after: Optional[int] = None
 
+    # --- adversarial failure worlds (worlds.py; closed-form
+    # --- (seed, tick, node) draws shared by both models) ---
+    #: Network partition: >= 2 hashes every node into that many
+    #: groups; cross-group sends are blocked while the window below is
+    #: open (heals when it closes).  0 disables.
+    partition_groups: int = 0
+    #: Partition window: cross-group sends blocked for
+    #: ``open < t <= close`` (the drop-window convention).
+    partition_open_tick: int = 0
+    partition_close_tick: int = 0
+    #: Asymmetric per-link drop: replaces the uniform ``msg_drop_prob``
+    #: with a hashed per-(sender, receiver) threshold of mean
+    #: ``msg_drop_prob`` (max ~2x), active during the drop window.
+    asym_drop: bool = False
+    #: Correlated failure wave: > 0 fails that many nodes in the
+    #: contiguous ring block from a seeded epicenter, one radius step
+    #: per ``wave_speed`` ticks from ``wave_tick`` (-1: ``fail_tick``).
+    #: Replaces the scripted single/multi failure, like churn does.
+    wave_size: int = 0
+    wave_tick: int = -1
+    wave_speed: int = 1
+    #: Zombie / stale-table peers: window-failed peers keep gossiping
+    #: their frozen table (and frozen heartbeat) instead of going
+    #: silent — the false-positive stress world.
+    zombie: bool = False
+    #: Flapping members: > 0 selects that fraction of nodes to fail and
+    #: rejoin periodically inside ``[flap_open, flap_close]`` with a
+    #: closed-form duty cycle (down ``flap_down`` of every
+    #: ``flap_period`` ticks; -1 windows default to the churn
+    #: machinery's quarter points).
+    flap_rate: float = 0.0
+    flap_period: int = 32
+    flap_down: int = 8
+    flap_open_tick: int = -1
+    flap_close_tick: int = -1
+
+    def __post_init__(self):
+        if self.model == "overlay":
+            n = self.max_nnb
+            if n < 4 or n & (n - 1) != 0:
+                lo = 1 << max(2, n.bit_length() - 1)
+                hi = max(4, 1 << n.bit_length())
+                near = lo if (n - lo) <= (hi - n) else hi
+                raise ValueError(
+                    f"overlay peer count must be a power of two >= 4 "
+                    f"(the XOR partner exchange pairs node i with "
+                    f"i ^ mask over a 2^b address space), got n={n}; "
+                    f"nearest valid n is {near} (or {lo}/{hi})")
+        if self.partition_groups == 1 or self.partition_groups < 0:
+            raise ValueError(
+                f"partition_groups must be 0 (off) or >= 2, got "
+                f"{self.partition_groups}")
+        if self.partition_groups >= 2:
+            if self.partition_close_tick <= self.partition_open_tick:
+                raise ValueError(
+                    f"partition window ({self.partition_open_tick}, "
+                    f"{self.partition_close_tick}] is empty; close must "
+                    "exceed open")
+            # a window that opens after the run ends silently never
+            # engages (same early-failure rule as the flap window;
+            # close past the end is legal — "never heals")
+            if self.partition_open_tick >= self.total_ticks:
+                raise ValueError(
+                    f"partition opens at tick "
+                    f"{self.partition_open_tick}, after the run ends "
+                    f"at {self.total_ticks} — the world would never "
+                    "engage")
+        if self.asym_drop:
+            if not self.drop_msg:
+                raise ValueError(
+                    "asym_drop rides the drop window; set drop_msg=True")
+            if not 0.0 < self.msg_drop_prob < 0.5:
+                raise ValueError(
+                    f"asym_drop needs 0 < msg_drop_prob < 0.5 (per-link "
+                    f"probabilities reach 2x the mean), got "
+                    f"{self.msg_drop_prob}")
+        if self.wave_size < 0:
+            raise ValueError(f"wave_size must be >= 0, got {self.wave_size}")
+        if self.wave_size > 0:
+            if self.wave_speed < 1:
+                raise ValueError(
+                    f"wave_speed must be >= 1, got {self.wave_speed}")
+            if self.churn_rate > 0:
+                raise ValueError(
+                    "wave_size and churn_rate both replace the scripted "
+                    "failure; enable at most one")
+            start = self.fail_tick if self.wave_tick < 0 else self.wave_tick
+            if start >= self.total_ticks:
+                raise ValueError(
+                    f"wave epicenter fails at tick {start}, after the "
+                    f"run ends at {self.total_ticks} — the world would "
+                    "never engage")
+        if self.flap_rate < 0 or self.flap_rate > 1:
+            raise ValueError(
+                f"flap_rate must be in [0, 1], got {self.flap_rate}")
+        if self.flap_rate > 0:
+            if not 1 <= self.flap_down < self.flap_period:
+                raise ValueError(
+                    f"flapping needs 1 <= flap_down < flap_period, got "
+                    f"down={self.flap_down} period={self.flap_period}")
+            # the resolved window must admit at least one completable
+            # cycle (anchor = flap_open in the best case), or the
+            # world silently never engages — fail early instead
+            lo = self.total_ticks // 4 if self.flap_open_tick < 0 \
+                else self.flap_open_tick
+            hi = (3 * self.total_ticks) // 4 if self.flap_close_tick < 0 \
+                else self.flap_close_tick
+            if lo + self.flap_down > hi:
+                raise ValueError(
+                    f"flap window [{lo}, {hi}] cannot complete a "
+                    f"single down phase of {self.flap_down} ticks — "
+                    "no node would ever flap; widen the window or "
+                    "shrink flap_down")
+
+    def worlds_key(self) -> tuple:
+        """Hashable digest of the ACTIVE adversarial worlds — the
+        static-branch knobs a compiled tick bakes in.  Empty for the
+        course worlds; folded into the dense fleet shape key, the
+        run-cache keys, and the kernel support gates (the Pallas
+        mega/grid kernels do not compile the new worlds — world
+        configs take the XLA paths)."""
+        ws = []
+        if self.partition_groups >= 2:
+            ws.append(("part", self.partition_groups,
+                       self.partition_open_tick,
+                       self.partition_close_tick))
+        if self.asym_drop:
+            ws.append(("asym",))
+        if self.wave_size > 0:
+            ws.append(("wave", self.wave_size, self.wave_tick,
+                       self.wave_speed))
+        if self.zombie:
+            ws.append(("zombie",))
+        if self.flap_rate > 0:
+            ws.append(("flap", self.flap_rate, self.flap_period,
+                       self.flap_down, self.flap_open_tick,
+                       self.flap_close_tick))
+        return tuple(ws)
+
+    @property
+    def has_worlds(self) -> bool:
+        return bool(self.worlds_key())
+
     @property
     def n(self) -> int:
         """Number of peers (the reference's EN_GPSZ, Params.cpp:29)."""

@@ -65,6 +65,11 @@ def active_bound(cfg: SimConfig) -> int:
     capped at N.
     """
     n, total = cfg.n, cfg.total_ticks
+    if cfg.has_worlds:
+        # adversarial worlds (worlds.py) fail/flap/partition hashed
+        # node sets drawn from the run seed — the corner must stay
+        # seed-independent, so world configs run full width
+        return n
     if cfg.step_rate < 0:
         # the bisection requires start_tick(i) nondecreasing in i; a
         # negative step_rate (the field is an unvalidated float) breaks
@@ -184,10 +189,8 @@ def make_corner_run(cfg: SimConfig, a: int, block_size: int = 128,
             return final_a, ev
 
     def run_body(state: WorldState, sched: Schedule):
-        sched_a = Schedule(
-            start_tick=sched.start_tick[:a], fail_tick=sched.fail_tick[:a],
-            rejoin_tick=sched.rejoin_tick[:a],
-            drop_active=sched.drop_active, drop_prob=sched.drop_prob)
+        from ..state import slice_schedule
+        sched_a = slice_schedule(sched, a)
         final_a, ev = inner(_slice_state(state, a), sched_a)
         pad = ((0, 0), (0, n - a))
         ev = TickEvents(added=ev.added, removed=ev.removed,

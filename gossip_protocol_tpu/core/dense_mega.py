@@ -34,7 +34,14 @@ def dense_mega_supported(cfg: SimConfig, with_events: bool = False) -> bool:
     two extra (S, N, N) event planes in VMEM, so its envelope is
     smaller than bench mode's."""
     limit = DENSE_MEGA_N_LIMIT if with_events else DENSE_MEGA_N_LIMIT_BENCH
-    return 16 <= cfg.n <= limit and cfg.n % 8 == 0
+    # the adversarial worlds (worlds.py) are not compiled into the
+    # megakernel — except the WAVE, which is pure schedule data (it
+    # only rewrites the fail_tick array the kernel already consumes);
+    # zombie/partition/asym/flap change tick semantics and take the
+    # XLA per-tick path
+    non_schedule_worlds = any(w[0] != "wave" for w in cfg.worlds_key())
+    return 16 <= cfg.n <= limit and cfg.n % 8 == 0 \
+        and not non_schedule_worlds
 
 
 def make_dense_mega_run(cfg: SimConfig, with_events: bool = False,

@@ -75,18 +75,38 @@ EVENT_AXES = TickEvents(added=0, removed=0, sent=0, recv=0)
 #: disagree on the drop plan fall back to SCHED_AXES_BATCHED.
 SCHED_AXES_SHARED_DROP = Schedule(start_tick=0, fail_tick=0,
                                   rejoin_tick=0, drop_active=None,
-                                  drop_prob=None)
+                                  drop_prob=None,
+                                  # the partition WINDOW rides the
+                                  # shared plane (window scalars are
+                                  # config values the whole bucket
+                                  # agrees on); the hashed group/flap/
+                                  # link assignments are seed data and
+                                  # stay per-lane
+                                  part_group=0, part_on=None,
+                                  part_open=None, part_close=None,
+                                  link_prob=0, flap_mask=0,
+                                  flap_phase=0, flap_period=0,
+                                  flap_down=0, flap_close=0)
 SCHED_AXES_BATCHED = Schedule(start_tick=0, fail_tick=0, rejoin_tick=0,
-                              drop_active=0, drop_prob=0)
+                              drop_active=0, drop_prob=0,
+                              part_group=0, part_on=0, part_open=0,
+                              part_close=0, link_prob=0, flap_mask=0,
+                              flap_phase=0, flap_period=0, flap_down=0,
+                              flap_close=0)
 
 
 def _shared_drop(cfgs) -> bool:
-    """May the fleet share one unbatched drop plan across lanes?"""
+    """May the fleet share one unbatched drop/partition plan across
+    lanes?  (The partition window gates sends exactly like the drop
+    window, so it rides the same shared plane.)"""
     c0 = cfgs[0]
     return all((c.drop_msg, c.drop_open_tick, c.drop_close_tick,
-                c.msg_drop_prob)
+                c.msg_drop_prob, c.partition_groups,
+                c.partition_open_tick, c.partition_close_tick)
                == (c0.drop_msg, c0.drop_open_tick, c0.drop_close_tick,
-                   c0.msg_drop_prob) for c in cfgs[1:])
+                   c0.msg_drop_prob, c0.partition_groups,
+                   c0.partition_open_tick, c0.partition_close_tick)
+               for c in cfgs[1:])
 
 
 def _stack_scheds(scheds, shared_drop: bool, stack=None):
@@ -100,12 +120,12 @@ def _stack_scheds(scheds, shared_drop: bool, stack=None):
     st = stack(scheds)
     if not shared_drop:
         return st
-    return Schedule(
-        start_tick=st.start_tick,
-        fail_tick=st.fail_tick,
-        rejoin_tick=st.rejoin_tick,
+    return st.replace(
         drop_active=scheds[0].drop_active,
-        drop_prob=scheds[0].drop_prob)
+        drop_prob=scheds[0].drop_prob,
+        part_on=scheds[0].part_on,
+        part_open=scheds[0].part_open,
+        part_close=scheds[0].part_close)
 
 
 def _check_stackable(trees) -> None:
@@ -230,7 +250,7 @@ def fleet_shape_key(cfg: SimConfig):
     if cfg.model == "overlay":
         return ("overlay", cfg.replace(seed=0))
     return ("full_view", cfg.n, cfg.t_remove, cfg.total_ticks,
-            cfg.rejoin_after is None)
+            cfg.rejoin_after is None, cfg.worlds_key())
 
 
 def _shape_mismatch(fleet_cfg: SimConfig, lane_cfg: SimConfig) -> str:
@@ -250,7 +270,13 @@ def _shape_mismatch(fleet_cfg: SimConfig, lane_cfg: SimConfig) -> str:
         names = [f.name for f in dataclasses.fields(SimConfig)
                  if f.name != "seed"]
     else:
-        names = ["max_nnb", "t_remove", "total_ticks"]
+        names = ["max_nnb", "t_remove", "total_ticks",
+                 # the adversarial worlds are static tick branches
+                 "partition_groups", "partition_open_tick",
+                 "partition_close_tick", "asym_drop", "wave_size",
+                 "wave_tick", "wave_speed", "zombie", "flap_rate",
+                 "flap_period", "flap_down", "flap_open_tick",
+                 "flap_close_tick"]
     diffs = [f"{n}={getattr(lane_cfg, n)!r} != fleet "
              f"{n}={getattr(fleet_cfg, n)!r}"
              for n in names
@@ -919,12 +945,8 @@ class FleetSimulation:
         def stage():
             scheds = [make_schedule_host(c) for c in cfgs]
             if corner:
-                lane_scheds = [Schedule(
-                    start_tick=s.start_tick[:a],
-                    fail_tick=s.fail_tick[:a],
-                    rejoin_tick=s.rejoin_tick[:a],
-                    drop_active=s.drop_active, drop_prob=s.drop_prob)
-                    for s in scheds]
+                from ..state import slice_schedule
+                lane_scheds = [slice_schedule(s, a) for s in scheds]
             else:
                 lane_scheds = scheds
             return scheds, self._stack_scheds_dev(lane_scheds, shared)

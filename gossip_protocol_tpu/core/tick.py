@@ -100,7 +100,16 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
     na = n if n_active is None else n_active
     assert na <= n
     t_remove = cfg.t_remove
-    churn = cfg.rejoin_after is not None
+    # flap up-edges are rejoin events (fresh-nodeStart wipes), so the
+    # flap world compiles the churn path in
+    churn = cfg.rejoin_after is not None or cfg.flap_rate > 0
+    # adversarial worlds (worlds.py): partition and asym-drop ride the
+    # drop plane (mask-level, so the fused TPU path gets them for
+    # free); zombie changes dissemination and the direct-sender credit,
+    # which the fused kernel does not compile — gated below
+    partition = cfg.partition_groups >= 2
+    asym = cfg.asym_drop
+    zombie = cfg.zombie
     assert n % comm.n_shards == 0, "peer count must divide the mesh axis"
     # the fused epilogue kernel needs its tile divisibility (row tile
     # 64, sublane-aligned — mirrors the asserts in fused_tick_update)
@@ -112,7 +121,8 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
     # merge when use_pallas is on).
     _tr = min(64, n)
     fused = (isinstance(comm, LocalComm) and comm.use_pallas
-             and n <= 4096 and n % _tr == 0 and _tr % 8 == 0)
+             and n <= 4096 and n % _tr == 0 and _tr % 8 == 0
+             and not zombie)
 
     def tick(state: WorldState, sched: Schedule):
         t = state.tick
@@ -138,7 +148,7 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
         # already dropped) — the wipe is safe anywhere in the tick.
         # Statically compiled out for no-churn configs.
         if churn:
-            rejoining = t == sched.rejoin_tick
+            rejoining = sched.rejoining_at(t)
             keep_rows = ~rejoining[row_ids]
             st_known = state.known & keep_rows[:, None]
             st_hb = state.hb * keep_rows[:, None]
@@ -175,15 +185,28 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
         own_hb = st_own_hb + ops.astype(jnp.int32)       # MP1Node.cpp:337
         ops_rows = ops[row_ids]
 
-        # ENsend drop injection (EmulNet.cpp:90-94)
+        # ENsend drop injection (EmulNet.cpp:90-94); the asym world
+        # swaps the uniform threshold for the per-link matrix inside
+        # the same windowed draw
         gdrop_all, qdrop, pdrop = tick_drop_masks(
-            state.rng, t, na, sched.drop_active[t], sched.drop_prob)
+            state.rng, t, na, sched.drop_active[t], sched.drop_prob,
+            link_prob=sched.link_prob[:na, :na] if asym else None)
         if na < n:
             # embed the active-corner stream; pairs outside the corner
             # never carry a send, so their mask bits are dead
             gdrop_all = jnp.zeros((n, n), bool).at[:na, :na].set(gdrop_all)
             qdrop = jnp.zeros((n,), bool).at[:na].set(qdrop)
             pdrop = jnp.zeros((n,), bool).at[:na].set(pdrop)
+        if partition:
+            # the partition world rides the drop plane: cross-group
+            # sends are "dropped" at send time while the window is
+            # open — a deterministic mask OR'd outside the drop cond,
+            # so the windowed PRNG draw stays a real cond
+            pa = sched.part_active_at(t)
+            cross = sched.part_group[:, None] != sched.part_group[None, :]
+            gdrop_all = gdrop_all | (pa & cross)
+            qdrop = qdrop | (pa & cross[:, INTRODUCER])
+            pdrop = pdrop | (pa & cross[INTRODUCER, :])
         gdrop = comm.slice_rows(gdrop_all)               # local sender rows
         joinreq_sent = joinreq_new & ~qdrop
         rep_out = jreq
@@ -253,11 +276,20 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
         # A known sender's heartbeat is *incremented* locally (not
         # adopted) and its timestamp refreshed; an unknown sender is
         # added with heartbeat 1 (MP1Node.cpp:236-242, 265-280).
+        # Zombie world: direct-sender credit models "a message from
+        # you proves you are alive" — a zombie's message carries a
+        # FROZEN heartbeat, which proves nothing, so senders that were
+        # window-failed at the send tick (t-1) earn no credit and are
+        # never added; their stale piggyback tables still merge by the
+        # ordinary strictly-larger-heartbeat rule above.
         known_pb = exists | padd
-        dinc = recv_from & known_pb
+        dcred = recv_from
+        if zombie:
+            dcred = dcred & ~sched.window_failed_at(t - 1)[None, :]
+        dinc = dcred & known_pb
         hb = jnp.where(dinc, hb + 1, hb)
         ts = jnp.where(dinc, t, ts)
-        dadd = recv_from & ~known_pb & ~self_mask
+        dadd = dcred & ~known_pb & ~self_mask
         hb = jnp.where(dadd, 1, hb)
         ts = jnp.where(dadd, t, ts)
         known = exists | padd | dadd
@@ -288,8 +320,19 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
         stale = staleness_mask(ops_rows, known, ts, t, t_remove)
         known = known & ~stale
 
-        # full-list gossip to every remaining member (MP1Node.cpp:350-361)
-        send = ops_rows[:, None] & known
+        # full-list gossip to every remaining member (MP1Node.cpp:350-361);
+        # zombies keep sending their frozen tables (their rows merged
+        # nothing and skipped detection above, so ``known`` is exactly
+        # the table frozen at their fail tick)
+        send_rows = ops_rows
+        if zombie:
+            # in_group is frozen for a failed peer (only a rejoin wipe
+            # clears it), so this is "was in the group when it failed"
+            # — a peer that failed before ever joining stays silent,
+            # like the reference's in-group-gated gossip loop
+            send_rows = send_rows \
+                | (sched.window_failed_at(t) & in_group)[row_ids]
+        send = send_rows[:, None] & known
         gossip_sent = send & ~gdrop
 
         # unconsumed traffic stays in flight (the EmulNet buffer holds
@@ -400,7 +443,10 @@ def make_run(cfg: SimConfig, block_size: int = 128, with_events: bool = True,
     # kernel already does) is covered by construction.
     key = (cfg.n, cfg.t_remove, cfg.total_ticks, block_size, with_events,
            comm.use_pallas, mega, cfg.rejoin_after is not None,
-           a if corner else cfg.n, plan_signature(cfg))
+           a if corner else cfg.n, plan_signature(cfg),
+           # the adversarial worlds are static branches in the tick
+           # (zombie/asym/partition/flap), so they are program identity
+           cfg.worlds_key())
     if key in _RUN_CACHE:
         return _RUN_CACHE[key]
     _BUILD_COUNT += 1

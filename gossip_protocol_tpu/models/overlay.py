@@ -103,6 +103,9 @@ from flax import struct
 from ..config import INTRODUCER, SimConfig
 from ..state import NEVER
 from ..utils.hash32 import mix32, threshold32
+from ..worlds import (SALT_FLAP, SALT_FLAP_PHASE, SALT_LINK, SALT_PART,
+                      flap_threshold, flap_window, partition_window,
+                      wave_center, wave_start)
 
 #: id field width in the packed priority key: ids < 2^20, and the XOR
 #: exchange needs a power-of-two peer count, so the largest supported
@@ -172,6 +175,24 @@ class OverlaySchedule:
     drop_thr: jax.Array     # u32 — per-message Bernoulli threshold
     deg_thr: jax.Array      # u32[F-1] — power-law out-degree CDF
                             #   thresholds (degree_thresholds)
+    # --- adversarial failure worlds (worlds.py): every draw below is
+    # --- a pure (seed, tick, node) counter hash, so lanes stay
+    # --- bit-replayable and the numpy oracle replays them exactly ---
+    part_groups: jax.Array  # u32 — partition group count (0 = off)
+    part_open: jax.Array    # i32 — cross-group sends blocked:
+    part_close: jax.Array   # i32   open < t <= close
+    asym_on: jax.Array      # bool — per-link drop thresholds
+    wave_size: jax.Array    # i32 — correlated wave victims (0 = off)
+    wave_tick: jax.Array    # i32 — resolved wave start tick
+    wave_speed: jax.Array   # i32 — radius step per this many ticks
+    wave_center: jax.Array  # i32 — seeded epicenter
+    wave_mod: jax.Array     # i32 — ring modulus (the peer count)
+    zombie_on: jax.Array    # bool — window-failed peers keep gossiping
+    flap_thr: jax.Array     # u32 — flapping-member threshold (0 = off)
+    flap_period: jax.Array  # i32
+    flap_down: jax.Array    # i32 — down ticks per period
+    flap_open: jax.Array    # i32 — resolved window
+    flap_close: jax.Array   # i32
 
     def start_of(self, i):
         return (i * self.step_num) // self.step_den
@@ -188,9 +209,18 @@ class OverlaySchedule:
             % self.churn_span.astype(jnp.uint32)).astype(jnp.int32)
         scripted = jnp.where((i >= self.victim_lo) & (i < self.victim_hi),
                              self.fail_tick, NEVER)
+        # correlated wave: the wave_size nodes in the contiguous ring
+        # block from the epicenter fail one radius step per wave_speed
+        # ticks (replaces the scripted draw, like churn does)
+        off = (i - self.wave_center) % jnp.maximum(self.wave_mod, 1)
+        wave = jnp.where((off < self.wave_size) & (i != INTRODUCER),
+                         self.wave_tick
+                         + off // jnp.maximum(self.wave_speed, 1),
+                         NEVER)
+        base = jnp.where(self.wave_size > 0, wave, scripted)
         return jnp.where(self.churn_thr > 0,
                          jnp.where(self._churned(i), churn_fail, NEVER),
-                         scripted)
+                         base)
 
     def rejoin_of(self, i):
         fail = self.fail_of(i)
@@ -199,8 +229,63 @@ class OverlaySchedule:
         return jnp.where((fail != NEVER) & (after != NEVER),
                          fail + after, NEVER)
 
+    def _flap(self, i, t):
+        """(failed, rejoining) under the flap world: down for
+        positions [1, flap_down] of each period from the node's hashed
+        anchor, rejoining at position flap_down; only cycles completing
+        before flap_close run (the window always ends clean)."""
+        iu = i.astype(jnp.uint32) if hasattr(i, "astype") else np.uint32(i)
+        sel = (mix32(self.seed, iu, np.uint32(SALT_FLAP))
+               < self.flap_thr) & (i != INTRODUCER)
+        per = jnp.maximum(self.flap_period, 1)
+        anchor = self.flap_open + (
+            mix32(self.seed, iu, np.uint32(SALT_FLAP_PHASE))
+            % per.astype(jnp.uint32)).astype(jnp.int32)
+        pos = t - anchor
+        c = pos // per
+        off = pos - c * per
+        ok = sel & (pos >= 1) \
+            & (anchor + c * per + self.flap_down <= self.flap_close)
+        return (ok & (off >= 1) & (off <= self.flap_down),
+                ok & (off == self.flap_down))
+
+    def window_failed_at(self, i, t):
+        """The WINDOW component of :meth:`failed_at` (scripted / churn
+        / wave) — the failures the zombie world applies to."""
+        return (t > self.fail_of(i)) & (t <= self.rejoin_of(i))
+
+    def failed_at(self, i, t):
+        f, _ = self._flap(i, t)
+        return self.window_failed_at(i, t) | f
+
+    def rejoining_at(self, i, t):
+        _, r = self._flap(i, t)
+        return (t == self.rejoin_of(i)) | r
+
     def drop_active(self, t):
         return self.drop_on & (t > self.drop_open) & (t <= self.drop_close)
+
+    def part_active(self, t):
+        """bool scalar: cross-group sends blocked at tick ``t``."""
+        return (self.part_groups > 0) & (t > self.part_open) \
+            & (t <= self.part_close)
+
+    def group_of(self, i):
+        """Hashed partition group of node ``i`` (0 when off)."""
+        iu = i.astype(jnp.uint32) if hasattr(i, "astype") else np.uint32(i)
+        return (mix32(self.seed, iu, np.uint32(SALT_PART))
+                % jnp.maximum(self.part_groups, np.uint32(1))
+                ).astype(jnp.int32)
+
+    def link_thr(self, iu, ju):
+        """u32 per-link drop threshold of link i -> j (asym world):
+        ``H(seed, i*N+j) % 2*drop_thr`` — uniform in [0, 2*thr), mean
+        ``drop_thr``; i*N+j wraps in uint32 at huge N, deliberately
+        (it is a hash input and both backends wrap identically)."""
+        two = self.drop_thr * np.uint32(2)
+        return mix32(self.seed,
+                     iu * self.wave_mod.astype(jnp.uint32) + ju,
+                     np.uint32(SALT_LINK)) % jnp.maximum(two, np.uint32(1))
 
 
 def make_overlay_schedule(cfg: SimConfig) -> OverlaySchedule:
@@ -233,6 +318,10 @@ def make_overlay_schedule(cfg: SimConfig) -> OverlaySchedule:
         else:
             victim_lo = (int(u * n) % n) // 2
             victim_hi = victim_lo + n // 2
+    # resolved adversarial-world windows (worlds.py — seed-independent
+    # config functions, so they ride the segment planner / bucket keys)
+    part_open, part_close = partition_window(cfg)
+    flap_lo, flap_hi = flap_window(cfg)
     # numpy scalars, deliberately: a schedule build must dispatch ZERO
     # eager device ops.  Eager ``jnp`` scalar creation is a tiny XLA
     # program each; on the serving path a fleet program is often in
@@ -262,6 +351,23 @@ def make_overlay_schedule(cfg: SimConfig) -> OverlaySchedule:
         drop_close=np.int32(cfg.drop_close_tick),
         drop_thr=np.uint32(threshold32(cfg.msg_drop_prob)),
         deg_thr=np.asarray(degree_thresholds(cfg, resolved_dims(cfg)[1])),
+        part_groups=np.uint32(cfg.partition_groups
+                              if cfg.partition_groups >= 2 else 0),
+        part_open=np.int32(part_open),
+        part_close=np.int32(part_close),
+        asym_on=np.bool_(bool(cfg.asym_drop)),
+        wave_size=np.int32(cfg.wave_size),
+        wave_tick=np.int32(wave_start(cfg) if cfg.wave_size > 0 else 0),
+        wave_speed=np.int32(max(cfg.wave_speed, 1)),
+        wave_center=np.int32(wave_center(cfg) if cfg.wave_size > 0
+                             else 0),
+        wave_mod=np.int32(n),
+        zombie_on=np.bool_(bool(cfg.zombie)),
+        flap_thr=np.uint32(flap_threshold(cfg)),
+        flap_period=np.int32(max(cfg.flap_period, 1)),
+        flap_down=np.int32(cfg.flap_down),
+        flap_open=np.int32(flap_lo),
+        flap_close=np.int32(flap_hi if cfg.flap_rate > 0 else -1),
     )
 
 
@@ -484,7 +590,17 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     powerlaw = cfg.topology == "powerlaw"
-    can_rejoin = cfg.churn_rate > 0 or cfg.rejoin_after is not None
+    # adversarial failure worlds (worlds.py) — static tick branches,
+    # like powerlaw/can_rejoin: the compiled program is world-specific
+    # (cfg.worlds_key() rides every run/bucket cache key)
+    part = cfg.partition_groups >= 2
+    asym = cfg.asym_drop
+    zomb = cfg.zombie
+    flap = cfg.flap_rate > 0
+    # flap up-edges are rejoin events (fresh-nodeStart wipes), so the
+    # flap world compiles the churn/rejoin path in
+    can_rejoin = cfg.churn_rate > 0 or cfg.rejoin_after is not None \
+        or flap
     n = cfg.n
     k, f = resolved_dims(cfg)
     # shapes outside the fused kernel's envelope (k >= N_COUNTERS
@@ -507,7 +623,12 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
     nl = n // p
     assert nl * p == n and nl & (nl - 1) == 0, \
         "shard count must divide the peer count (both powers of two)"
-    use_kernel = bool(use_pallas) and k >= N_COUNTERS and nl >= 8
+    # the fused kernel does not compile the adversarial worlds (its
+    # detection/metrics scalars know only the churn/scripted windows,
+    # and zombie/partition change merge/send semantics) — world
+    # configs take the bit-identical XLA phases
+    use_kernel = bool(use_pallas) and k >= N_COUNTERS and nl >= 8 \
+        and not cfg.has_worlds
     factors = _xor_factors(nl)
     if with_coverage is None:
         with_coverage = n <= COVERAGE_N_LIMIT
@@ -560,10 +681,18 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
         start = sched.start_of(rows)
         fail = sched.fail_of(rows)
         rejoin = sched.rejoin_of(rows)
-        failed = (t > fail) & (t <= rejoin)
-        proc = (t > start) & ~failed
+        # the scripted/churn/wave fail WINDOW, kept separate from the
+        # flap overlay: the zombie world applies to window failures
+        # only (a flap down-phase is ordinary silence)
+        failed_win = (t > fail) & (t <= rejoin)
+        failed = failed_win
         rejoining = (t == rejoin) if can_rejoin \
             else jnp.zeros_like(start, bool)
+        if flap:
+            fl_f, fl_r = sched._flap(rows, t)
+            failed = failed | fl_f
+            rejoining = rejoining | fl_r
+        proc = (t > start) & ~failed
 
         # local row block
         row_start = comm.row_start(n)
@@ -765,9 +894,19 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
                 keymax, p_acc = table_merge(
                     keymax, p_acc, in_ids, in_ts, in_p, valid)
                 if self_entry_fresh:
+                    cred = ok
+                    if zomb:
+                        # zombie world: a message from a window-failed
+                        # sender carries a FROZEN heartbeat — its
+                        # liveness claim is dated at the fail tick, not
+                        # the send tick, so it earns no direct
+                        # self-entry; its stale table rows still merged
+                        # above under the ordinary freshness gates
+                        cred = ok & ~sched.window_failed_at(partner,
+                                                            t - 1)
                     keymax, p_acc = entry_merge(
                         keymax, p_acc, partner,
-                        jnp.broadcast_to(t - 1, (nl,)), own_p, ok)
+                        jnp.broadcast_to(t - 1, (nl,)), own_p, cred)
                 return (keymax, p_acc, recv_cnt), None
 
             (keymax, p_acc, recv_cnt), _ = jax.lax.scan(
@@ -786,11 +925,15 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
                                         j_valid)
             if self_entry_fresh:
                 intro_vec = jnp.broadcast_to(jnp.int32(INTRODUCER), (nl,))
+                j_ok = jrep_l & (intro_vec != rows_g)
+                if zomb:
+                    j_ok = j_ok & ~sched.window_failed_at(
+                        jnp.int32(INTRODUCER), t - 1)
                 keymax, p_acc = entry_merge(
                     keymax, p_acc, intro_vec,
                     jnp.broadcast_to(t - 1, (nl,)),
                     jnp.broadcast_to(bc[2 * k].astype(jnp.int32), (nl,)),
-                    jrep_l & (intro_vec != rows_g))
+                    j_ok)
 
             # ---- JOINREQ aggregates into (the shard holding) row 0 -
             on0 = comm.on_first_shard()
@@ -816,6 +959,9 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
             subj = jnp.clip(ids1, 0)
             subj_fail = sched.fail_of(subj)
             subj_failed = (t > subj_fail) & (t <= sched.rejoin_of(subj))
+            if flap:
+                # a flap-down subject's removal is a TRUE positive
+                subj_failed = subj_failed | sched._flap(subj, t)[0]
             removals = comm.psum(stale.sum().astype(jnp.int32))
             false_removals = comm.psum(
                 (stale & ~subj_failed).sum().astype(jnp.int32))
@@ -833,12 +979,31 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
         # ---- nodeStart / rejoin sends (replicated vector math) -----
         joinreq_new = starting & ~intro_onehot
         active = sched.drop_active(t)
+        if asym:
+            # asymmetric per-link drop (worlds.py): the JOINREQ row
+            # uses each sender's link to the introducer, the JOINREP
+            # row the introducer's link to each receiver — same single
+            # windowed draw, per-link threshold
+            qthr = sched.link_thr(rows_gu_all, np.uint32(INTRODUCER))
+            pthr = sched.link_thr(np.uint32(INTRODUCER), rows_gu_all)
+        else:
+            qthr = pthr = sched.drop_thr
         qdrop = mix32(seed, tu, rows_gu_all, np.uint32(_SALT_JOINREQ_DROP)) \
-            < sched.drop_thr
+            < qthr
         pdrop = mix32(seed, tu, rows_gu_all, np.uint32(_SALT_JOINREP_DROP)) \
-            < sched.drop_thr
+            < pthr
         joinreq_sent = joinreq_new & ~(active & qdrop)
         joinrep_sent = jreq & ~(active & pdrop)      # introducer's replies
+        if part:
+            # the partition world gates sends exactly like a drop
+            # decision: while the window is open, cross-group JOINREQ/
+            # JOINREP traffic is blocked at send time (a deterministic
+            # mask, no PRNG draw)
+            pa = sched.part_active(t)
+            grp = sched.group_of(rows)
+            cross_intro = grp != grp[INTRODUCER]
+            joinreq_sent = joinreq_sent & ~(pa & cross_intro)
+            joinrep_sent = joinrep_sent & ~(pa & cross_intro)
 
         # ---- slot-map re-roll at the SLOT_EPOCH boundary -----------
         # Every node re-slots its surviving entries into the next
@@ -885,9 +1050,32 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
 
         # ---- dissemination: set the in-flight flags ----------------
         fis = jnp.arange(f, dtype=jnp.uint32)
+        if part or asym:
+            # the partner of local row i on exchange slot fi of the
+            # NEXT tick's delivery is i ^ mask(t, fi) — known at send
+            # time, so both link-dependent worlds gate here
+            masks_nxt = jnp.stack([exchange_mask(seed, t, fi, n)
+                                   for fi in range(f)])
+            partners = rows_g[:, None] ^ masks_nxt[None, :]   # (Nl, F)
+        if asym:
+            gthr = sched.link_thr(rows_u[:, None],
+                                  partners.astype(jnp.uint32))
+        else:
+            gthr = sched.drop_thr
         gdrop = mix32(seed, tu, rows_u[:, None], fis[None, :],
-                      np.uint32(_SALT_GOSSIP_DROP)) < sched.drop_thr
-        send_flags = ops_l[:, None] & ~(active & gdrop)
+                      np.uint32(_SALT_GOSSIP_DROP)) < gthr
+        send_src = ops_l
+        if zomb:
+            # zombie world: window-failed in-group peers keep gossiping
+            # their FROZEN tables (their rows merged nothing and were
+            # skipped by detection while failed, so the payload is
+            # exactly the table at their fail tick)
+            send_src = ops_l | comm.slice_rows(failed_win & in_group0)
+        send_flags = send_src[:, None] & ~(active & gdrop)
+        if part:
+            send_flags = send_flags \
+                & ~(pa & (comm.slice_rows(grp)[:, None]
+                          != sched.group_of(partners)))
         if powerlaw:
             # scale-free out-degrees: node i gossips only on its first
             # deg(i) rounds (a static seeded node property; hubs cover
@@ -1021,6 +1209,9 @@ def make_overlay_run(cfg: SimConfig, length: int | None = None,
            cfg.churn_rate > 0 or cfg.rejoin_after is not None,
            # the grid kernel bakes churn-vs-scripted statically
            cfg.churn_rate > 0,
+           # the adversarial worlds are static tick branches
+           # (zombie/asym/partition/flap), so they are program identity
+           cfg.worlds_key(),
            # the segment plan is a function of the pinned start tick
            start_tick if grid else None,
            cfg.step_rate if grid else None,
@@ -1212,6 +1403,10 @@ class OverlayResult:
         fail = np.asarray(self.sched.fail_of(jnp.asarray(i)))
         rejoin = np.asarray(self.sched.rejoin_of(jnp.asarray(i)))
         failed = (t_end > fail) & (t_end <= rejoin)
+        # flapping members (worlds.py): a node in a down phase at the
+        # final tick is not live (no-op when the flap world is off)
+        fl_f, _ = self.sched._flap(jnp.asarray(i), jnp.int32(t_end))
+        failed = failed | np.asarray(fl_f)
         in_group = np.asarray(self.final_state.in_group)
         live = in_group & ~failed & (i != INTRODUCER)
         return np.flatnonzero(live & ~present)

@@ -59,7 +59,10 @@ def grid_supported(cfg: SimConfig) -> bool:
             and n <= (1 << ID_BITS)      # id field of the packed key
             and 2 * k <= 128 and k >= 8 and f <= 8
             and cfg.total_ticks <= 4094
-            and num * (n - 1) < 2 ** 31)
+            and num * (n - 1) < 2 ** 31
+            # the adversarial worlds (worlds.py) are not compiled into
+            # the grid kernel — world configs take the XLA tick
+            and not cfg.has_worlds)
 
 
 def _grid_kern_kwargs(cfg: SimConfig, k: int, f: int, b: int) -> dict:

@@ -56,7 +56,10 @@ def mega_supported(cfg: SimConfig) -> bool:
             and n <= MEGA_N_LIMIT and 2 * k + AUX_LANES <= 128 and f <= 7
             # the packed (ts+1)<<12 | hb+1 payload word caps runs at
             # 4094 ticks (make_overlay_tick asserts the same bound)
-            and cfg.total_ticks <= 4094)
+            and cfg.total_ticks <= 4094
+            # the adversarial worlds (worlds.py) are not compiled into
+            # the megakernel — world configs take the XLA tick
+            and not cfg.has_worlds)
 
 
 def _pack_state(cfg: SimConfig, state: OverlayState,

@@ -1,0 +1,530 @@
+"""Scenario catalog: named adversarial-failure families with
+closed-form correctness oracles, and a fleet-scale sweep that grades
+hundreds of seeded variants as ONE :class:`~..service.scheduler.FleetService`
+run.
+
+This is the protocol-level complement to the service-level chaos plane
+(service/faults.py, PR 5): the chaos plane injects faults into the
+SERVING machinery; this module injects failures into the SIMULATED
+WORLD (worlds.py — partitions that heal, asymmetric per-link loss,
+correlated failure waves, zombie peers gossiping stale tables,
+flapping members) and grades the failure detector against what the
+protocol provably owes under each.
+
+Every family is a pure ``(family, seed) -> SimConfig`` mapping whose
+windows are seed-independent config functions (seeds move WHICH nodes
+are hit, never WHEN the world acts — worlds.py), so a whole sweep
+buckets into one compiled program per family, its verdicts are pure
+seed functions, and a failing variant replays from its
+``(family, seed)`` pair alone (:func:`repro_command`).
+
+Oracle philosophy: each family asserts only what the protocol
+GUARANTEES in closed form — detection completeness at the exact
+``fail + TREMOVE + 1`` horizon where the world is loss-free, zero
+false removals of live members where silences stay under the
+staleness horizon, re-convergence after a heal where a discovery path
+exists — and the two models' honest differences are part of the
+catalog: a dense full-view cluster split longer than TREMOVE is
+PERMANENT (the reference protocol gossips only to known members — no
+discovery path back), while the overlay re-converges (its XOR
+exchange delivers by index, not by membership).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from .. import worlds
+from ..config import INTRODUCER, SimConfig
+from ..state import NEVER
+
+
+@dataclasses.dataclass(frozen=True)
+class Family:
+    """One named scenario family: a config builder + its oracle."""
+
+    name: str
+    #: one-line statement of what the world does and what is owed
+    claim: str
+    build: Callable[[int], SimConfig]
+    #: ``oracle(cfg, lane) -> [violation, ...]`` (empty = pass); the
+    #: lane is a FleetSimulation lane / solo result (dense: events +
+    #: final_state; overlay: metrics + final_state)
+    oracle: Callable[[SimConfig, object], list]
+    #: which adversarial world the family exercises (partition / asym /
+    #: wave / zombie / flapping) — sweep reports count distinct worlds
+    #: actually covered, not the catalog total
+    world: str
+
+
+# ---- shared oracle helpers -------------------------------------------
+
+def _dense_events(lane):
+    """{(observer, subject): first_removal_tick}, {(t, i, j) adds}."""
+    removed = np.asarray(lane.removed)
+    rem = {}
+    for t, i, j in zip(*np.nonzero(removed)):
+        rem.setdefault((int(i), int(j)), int(t))
+    adds = {(int(t), int(i), int(j))
+            for t, i, j in zip(*np.nonzero(np.asarray(lane.added)))}
+    return rem, adds
+
+
+def _dense_victims(cfg, lane):
+    """Victim ids + per-victim fail tick from the lane's schedule."""
+    fail = np.asarray(lane.fail_tick)
+    vic = np.flatnonzero(fail != NEVER)
+    return vic, fail
+
+
+def _dense_detection_complete(cfg, lane, exact: bool) -> list:
+    """Every victim removed from every live observer's view — at
+    EXACTLY ``fail + t_remove + 1`` when the world is loss-free."""
+    bad = []
+    vic, fail = _dense_victims(cfg, lane)
+    if vic.size == 0:
+        return ["world never engaged: no victims scheduled"]
+    rem, _ = _dense_events(lane)
+    known = np.asarray(lane.final_state.known)
+    live = np.ones(cfg.n, bool)
+    live[vic] = False
+    for v in vic:
+        for i in np.flatnonzero(live):
+            if known[i, v]:
+                bad.append(f"victim {v} still in view of {i} at end")
+            t_rm = rem.get((int(i), int(v)))
+            horizon = int(fail[v]) + cfg.t_remove + 1
+            if t_rm is None:
+                if int(fail[v]) + cfg.t_remove + 1 <= cfg.total_ticks - 1:
+                    bad.append(f"victim {v} never removed by {i}")
+            elif exact and t_rm != horizon:
+                bad.append(f"victim {v} removed by {i} at {t_rm}, "
+                           f"expected exactly {horizon}")
+            elif not exact and t_rm > horizon + 4:
+                bad.append(f"victim {v} removed by {i} at {t_rm}, "
+                           f"past horizon {horizon}+4")
+    return bad
+
+
+def _dense_no_false_removals(cfg, lane) -> list:
+    """No removal event ever names a live (never-failed) subject."""
+    vic, _ = _dense_victims(cfg, lane)
+    rem, _ = _dense_events(lane)
+    bad = [f"live member {j} removed by {i} at t={t}"
+           for (i, j), t in rem.items() if j not in set(int(v) for v in vic)]
+    return bad
+
+
+def _dense_all_joined(cfg, lane) -> list:
+    ig = np.asarray(lane.final_state.in_group)
+    vic, fail = _dense_victims(cfg, lane)
+    expect = np.ones(cfg.n, bool)
+    expect[vic] = False
+    missing = np.flatnonzero(expect & ~ig)
+    return [f"nodes never joined: {missing.tolist()}"] if missing.size \
+        else []
+
+
+def _overlay_sched_arrays(cfg):
+    import jax.numpy as jnp
+    from .overlay import make_overlay_schedule
+    sched = make_overlay_schedule(cfg)
+    i = jnp.arange(cfg.n)
+    return (np.asarray(sched.fail_of(i)), np.asarray(sched.rejoin_of(i)))
+
+
+def _overlay_coverage(cfg, lane) -> list:
+    """Final-table guarantees, per the overlay's documented contract
+    (models/overlay.py module docstring): every live member is covered
+    by the UNION of views — all views, the same union
+    ``OverlayResult.uncovered_members`` samples — and no LIVE view
+    still names a failed subject (failed holders' frozen tables are
+    exempt: they stopped processing, so their stale victim entries are
+    structural, not a detection failure)."""
+    bad = []
+    fail, rejoin = _overlay_sched_arrays(cfg)
+    ids = np.asarray(lane.final_state.ids)
+    t_end = int(np.asarray(lane.final_state.tick))
+    failed = (t_end > fail) & (t_end <= rejoin)
+    if cfg.flap_rate > 0:
+        flap_at = worlds.make_flap_state(cfg)
+        flap = np.array([flap_at(i, t_end)[0] for i in range(cfg.n)])
+        failed = failed | flap
+    live = np.asarray(lane.final_state.in_group) & ~failed
+    present = np.zeros(cfg.n, bool)
+    present[ids[ids >= 0]] = True
+    i = np.arange(cfg.n)
+    unc = np.flatnonzero(live & ~present & (i != INTRODUCER))
+    if unc.size:
+        bad.append(f"live members uncovered at end: {unc.tolist()}")
+    vic = np.flatnonzero(failed)
+    if vic.size:
+        in_live = np.isin(ids[live], vic) & (ids[live] >= 0)
+        if in_live.any():
+            bad.append(f"{int(in_live.sum())} failed-subject entries "
+                       "still in live views at end")
+    return bad
+
+
+def _overlay_no_false_removals(cfg, lane) -> list:
+    fr = int(np.asarray(lane.metrics.false_removals).sum())
+    return [f"{fr} false removals of live members"] if fr else []
+
+
+# ---- the catalog ------------------------------------------------------
+
+def _d(seed, **kw):
+    base = dict(max_nnb=16, single_failure=True, drop_msg=False,
+                total_ticks=120, fail_tick=40, seed=seed)
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def _o(seed, **kw):
+    base = dict(model="overlay", max_nnb=64, single_failure=True,
+                drop_msg=False, total_ticks=136, fail_tick=48,
+                step_rate=8.0 / 64, seed=seed)
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def _partition_blip_oracle(cfg, lane):
+    bad = _dense_all_joined(cfg, lane)
+    rem, _ = _dense_events(lane)
+    if rem:
+        bad.append(f"sub-horizon partition caused {len(rem)} removals")
+    known = np.asarray(lane.final_state.known)
+    off = ~np.eye(cfg.n, dtype=bool)
+    if not (known | ~off).all():
+        bad.append("membership incomplete after the blip healed")
+    return bad
+
+
+def _partition_split_oracle(cfg, lane):
+    bad = _dense_all_joined(cfg, lane)
+    g = worlds.partition_groups_host(cfg)
+    rem, _ = _dense_events(lane)
+    cross = [(k, t) for k, t in rem.items() if g[k[0]] != g[k[1]]]
+    same = [(k, t) for k, t in rem.items() if g[k[0]] == g[k[1]]]
+    if not cross:
+        bad.append("partition never bit: no cross-group removals")
+    if same:
+        bad.append(f"partition disturbed same-group liveness: {same[:3]}")
+    known = np.asarray(lane.final_state.known)
+    same_m = g[:, None] == g[None, :]
+    off = ~np.eye(cfg.n, dtype=bool)
+    if not (known | ~(same_m & off)).all():
+        bad.append("same-group entries lost across the split")
+    if known[~same_m].any():
+        bad.append("cross-group entries survived a super-horizon split "
+                   "(no discovery path exists — where did they come from?)")
+    return bad
+
+
+def _asym_oracle(cfg, lane):
+    bad = _dense_all_joined(cfg, lane)
+    bad += _dense_detection_complete(cfg, lane, exact=False)
+    bad += _dense_no_false_removals(cfg, lane)
+    return bad
+
+
+def _wave_oracle(cfg, lane):
+    bad = _dense_detection_complete(cfg, lane, exact=True)
+    bad += _dense_no_false_removals(cfg, lane)
+    return bad
+
+
+def _zombie_oracle(cfg, lane):
+    bad = _dense_detection_complete(cfg, lane, exact=True)
+    bad += _dense_no_false_removals(cfg, lane)
+    # the false-positive stress the world exists for: once an observer
+    # removes the zombie, its stale table must not resurrect it
+    rem, adds = _dense_events(lane)
+    vic, _ = _dense_victims(cfg, lane)
+    for v in vic:
+        for (t, i, j) in adds:
+            if j == int(v) and (i, j) in rem and t > rem[(i, j)]:
+                bad.append(f"zombie {j} resurrected by {i} at t={t} "
+                           f"(removed at {rem[(i, j)]})")
+    return bad
+
+
+def _flap_oracle(cfg, lane):
+    bad = []
+    if worlds.flap_mask_host(cfg).sum() < 1:
+        bad.append("world never engaged: no flappers selected")
+    bad += _dense_no_false_removals(cfg, lane)
+    rem, _ = _dense_events(lane)
+    if rem:
+        # flap_down < t_remove: silences never cross the horizon
+        bad.append(f"sub-horizon flapping caused {len(rem)} removals")
+    bad += _dense_all_joined(cfg, lane)
+    return bad
+
+
+def _ov_partition_oracle(cfg, lane):
+    # the overlay's partition TOLERANCE: a super-horizon split still
+    # re-converges after the heal (delivery is by index)
+    return _overlay_coverage(cfg, lane)
+
+
+def _ov_wave_oracle(cfg, lane):
+    bad = _overlay_coverage(cfg, lane)
+    bad += _overlay_no_false_removals(cfg, lane)
+    return bad
+
+
+def _ov_zombie_oracle(cfg, lane):
+    bad = _overlay_coverage(cfg, lane)
+    bad += _overlay_no_false_removals(cfg, lane)
+    return bad
+
+
+def _ov_asym_oracle(cfg, lane):
+    return _overlay_coverage(cfg, lane)
+
+
+def _ov_flap_oracle(cfg, lane):
+    bad = []
+    if worlds.flap_mask_host(cfg).sum() < 1:
+        bad.append("world never engaged: no flappers selected")
+    bad += _overlay_coverage(cfg, lane)
+    return bad
+
+
+#: the catalog: family name -> Family.  Dense families grade the
+#: reference-faithful full-view protocol (exact horizons); overlay
+#: families grade the bounded-partial-view scaling model (coverage
+#: and purge guarantees).  Every one of the five worlds appears in
+#: both models except the dense split/blip pair, which together pin
+#: the partition world's two dense regimes.
+CATALOG: dict[str, Family] = {}
+
+
+def _register(name, claim, build, oracle):
+    world = name.split("_")[1]  # <model>_<world>[_<variant>]
+    CATALOG[name] = Family(name=name, claim=claim, build=build,
+                           oracle=oracle, world=world)
+
+
+_register(
+    "dense_partition_blip",
+    "a partition shorter than TREMOVE heals with zero removals",
+    lambda s: _d(s, partition_groups=2, partition_open_tick=30,
+                 partition_close_tick=42, fail_tick=10_000),
+    _partition_blip_oracle)
+_register(
+    "dense_partition_split",
+    "a partition longer than TREMOVE splits the full-view cluster "
+    "permanently (no discovery path), without touching same-group "
+    "liveness",
+    lambda s: _d(s, partition_groups=2, partition_open_tick=30,
+                 partition_close_tick=70, total_ticks=160,
+                 fail_tick=10_000),
+    _partition_split_oracle)
+_register(
+    "dense_asym_drop",
+    "per-link loss up to 2x the mean neither hides a real failure "
+    "nor manufactures a false one",
+    lambda s: _d(s, drop_msg=True, msg_drop_prob=0.12, asym_drop=True,
+                 drop_open_tick=10, drop_close_tick=110),
+    _asym_oracle)
+_register(
+    "dense_wave",
+    "a correlated k-node wave is detected victim-by-victim at exactly "
+    "fail + TREMOVE + 1",
+    lambda s: _d(s, single_failure=False, wave_size=6, wave_tick=40,
+                 wave_speed=2),
+    _wave_oracle)
+_register(
+    "dense_zombie",
+    "a zombie gossiping its frozen table is detected on the silent-"
+    "failure horizon and never resurrected",
+    lambda s: _d(s, zombie=True, total_ticks=140),
+    _zombie_oracle)
+_register(
+    "dense_flapping",
+    "flapping below the staleness horizon causes zero removals",
+    lambda s: _d(s, flap_rate=0.4, flap_period=24, flap_down=6,
+                 fail_tick=10_000, total_ticks=140),
+    _flap_oracle)
+_register(
+    "overlay_partition_heal",
+    "the overlay re-converges after a super-horizon partition "
+    "(index-addressed delivery is the discovery path the dense model "
+    "lacks)",
+    lambda s: _o(s, partition_groups=2, partition_open_tick=30,
+                 partition_close_tick=90, total_ticks=168,
+                 fail_tick=10_000),
+    _ov_partition_oracle)
+_register(
+    "overlay_asym_drop",
+    "asymmetric per-link loss leaves live coverage intact and the "
+    "victim purged",
+    lambda s: _o(s, drop_msg=True, msg_drop_prob=0.1, asym_drop=True,
+                 drop_open_tick=10, drop_close_tick=110),
+    _ov_asym_oracle)
+_register(
+    "overlay_wave",
+    "every wave victim is purged from every live view; live coverage "
+    "holds",
+    lambda s: _o(s, single_failure=False, wave_size=12, wave_tick=48,
+                 wave_speed=2, total_ticks=168),
+    _ov_wave_oracle)
+_register(
+    "overlay_zombie",
+    "a zombie's frozen tables earn no liveness credit: purged on "
+    "schedule, coverage intact",
+    lambda s: _o(s, zombie=True, total_ticks=168),
+    _ov_zombie_oracle)
+_register(
+    "overlay_flapping",
+    "sub-horizon flapping: no false removals, full coverage once the "
+    "flap window closes",
+    lambda s: _o(s, flap_rate=0.3, flap_period=24, flap_down=6,
+                 fail_tick=10_000, total_ticks=168),
+    _ov_flap_oracle)
+
+
+def variants(families=None, seeds_per_family: int = 20,
+             seed0: int = 1000) -> list:
+    """The sweep's (family, seed) list, seed-major interleaved (like
+    service/replay.build_trace: buckets fill concurrently)."""
+    fams = [CATALOG[f] for f in (families or sorted(CATALOG))]
+    return [(fam, seed0 + s) for s in range(seeds_per_family)
+            for fam in fams]
+
+
+def grade(family: Family, seed: int, lane) -> list:
+    """One variant's oracle verdict: a list of violations (empty =
+    pass)."""
+    return family.oracle(family.build(seed), lane)
+
+
+def _lane_digest(cfg: SimConfig, lane) -> str:
+    h = hashlib.sha256()
+    if cfg.model == "overlay":
+        for f in ("ids", "hb", "ts", "in_group", "own_hb"):
+            h.update(np.ascontiguousarray(
+                np.asarray(getattr(lane.final_state, f))).tobytes())
+    else:
+        for f in ("known", "hb", "ts", "in_group"):
+            h.update(np.ascontiguousarray(
+                np.asarray(getattr(lane.final_state, f))).tobytes())
+        h.update(np.ascontiguousarray(np.asarray(lane.removed)).tobytes())
+    return h.hexdigest()[:16]
+
+
+def repro_command(family: str, seed: int) -> str:
+    """The exact single-variant repro a sweep failure prints."""
+    return (f"PYTHONPATH=. python scripts/service_smoke.py scenario "
+            f"--family {family} --seed {seed}")
+
+
+def run_solo(family: str, seed: int):
+    """One variant, no service — the repro path.  Returns
+    ``(violations, lane_digest)``."""
+    fam = CATALOG[family]
+    cfg = fam.build(seed)
+    from ..service.resilience import solo_execute
+    lane = solo_execute(cfg, "trace")
+    return grade(fam, seed, lane), _lane_digest(cfg, lane)
+
+
+def sweep(families=None, seeds_per_family: int = 20, max_batch: int = 8,
+          mesh=None, seed0: int = 1000, service=None,
+          raise_on_fail: bool = True) -> dict:
+    """Grade ``len(families) * seeds_per_family`` seeded scenario
+    variants as ONE FleetService run.
+
+    Gates enforced in-line: 100% of submitted variants reach a
+    terminal completed state (a stranded or failed handle raises), and
+    every variant's oracle verdict is recorded.  With the default
+    catalog and ``seeds_per_family=20`` that is 220 variants spanning
+    all five worlds on both models.  The returned ``verdict_digest`` /
+    ``outcome_digest`` are pure functions of (families, seeds, mesh
+    width): identical seeds must reproduce them digest-for-digest —
+    the scenario replay gate (scripts/service_smoke.py scenarios,
+    bench.py ``secondary.scenario_sweep``).
+
+    On oracle failures the report names each failing variant with its
+    violations AND the exact single-variant repro command.
+    """
+    from ..service.scheduler import FleetService
+    var = variants(families, seeds_per_family, seed0)
+    svc = service if service is not None else FleetService(
+        max_batch=max_batch, mesh=mesh)
+    done = set()
+    for fam, _ in var:
+        if fam.name not in done:
+            done.add(fam.name)
+            svc.warm(fam.build(seed0), "trace")
+    t0 = time.perf_counter()
+    handles = [(fam, seed, svc.submit(fam.build(seed), mode="trace"))
+               for fam, seed in var]
+    svc.drain()
+    wall = time.perf_counter() - t0
+    stranded = [h.request.rid for _, _, h in handles if not h.done]
+    failed = [h.request.rid for _, _, h in handles if h.failed]
+    if stranded or failed:
+        errs = "; ".join(f"rid {h.request.rid}: {h.exception()!r}"
+                         for _, _, h in handles if h.failed)[:500]
+        raise RuntimeError(
+            f"scenario sweep left {len(stranded)} stranded and "
+            f"{len(failed)} failed handles of {len(handles)}: {errs}")
+    rows = []
+    fails = []
+    per_family: dict[str, dict] = {}
+    for fam, seed, h in handles:
+        lane = h.result()
+        cfg = fam.build(seed)
+        violations = grade(fam, seed, lane)
+        rows.append((fam.name, seed, tuple(violations),
+                     _lane_digest(cfg, lane)))
+        pf = per_family.setdefault(fam.name, {"pass": 0, "fail": 0})
+        if violations:
+            pf["fail"] += 1
+            fails.append((fam.name, seed, violations))
+        else:
+            pf["pass"] += 1
+    verdict_digest = hashlib.sha256(
+        repr([(r[0], r[1], r[2]) for r in rows]).encode()).hexdigest()[:16]
+    outcome_digest = hashlib.sha256(
+        repr([(r[0], r[1], r[3]) for r in rows]).encode()).hexdigest()[:16]
+    stats = svc.stats()
+    report = {
+        "variants": len(var),
+        "families": len(done),
+        "worlds": len({fam.world for fam, _ in var}),
+        "passed": sum(pf["pass"] for pf in per_family.values()),
+        "failed": sum(pf["fail"] for pf in per_family.values()),
+        "pass_rate": round(sum(pf["pass"] for pf in per_family.values())
+                           / max(len(var), 1), 4),
+        "per_family": per_family,
+        "verdict_digest": verdict_digest,
+        "outcome_digest": outcome_digest,
+        "wall_s": round(wall, 3),
+        "devices": stats["devices"],
+        "dispatches": stats["dispatches"],
+        "mean_occupancy": stats["mean_occupancy"],
+        "buckets": stats["cache"]["buckets"],
+        "completed": stats["completed"],
+        "terminal_rate": round(
+            (len(handles) - len(stranded) - len(failed))
+            / max(len(handles), 1), 4),
+    }
+    if fails and raise_on_fail:
+        lines = [f"  {f}/{s}: {v[:2]}\n    repro: {repro_command(f, s)}"
+                 for f, s, v in fails[:8]]
+        raise RuntimeError(
+            f"scenario sweep: {len(fails)}/{len(var)} variants failed "
+            "their oracle:\n" + "\n".join(lines))
+    report["failures"] = [
+        {"family": f, "seed": s, "violations": list(v)[:4],
+         "repro": repro_command(f, s)} for f, s, v in fails]
+    return report

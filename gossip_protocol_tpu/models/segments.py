@@ -122,7 +122,19 @@ def step_fraction(step_rate: float) -> tuple[int, int]:
 
 
 def phase_windows(cfg: SimConfig) -> PhaseWindows:
-    """Seed-independent closed-form liveness windows of a config."""
+    """Seed-independent closed-form liveness windows of a config.
+
+    The adversarial worlds (worlds.py) fold in here, so scenario
+    configs flow through grid-kernel phase elision, checkpoint cuts,
+    and the serving bucket keys unchanged: the correlated failure
+    WAVE replaces the scripted fail tick with its radius-ramp window,
+    FLAPPING members widen the churn and join windows to the flap
+    window (every up-edge is a rejoin through the JOINREQ path), and
+    the PARTITION window unions into the drop window (it rides the
+    drop plane: sends can be blocked exactly while either is open).
+    Seeds move which nodes are hit, never these windows — that
+    invariance is what lets every lane of a fleet share one plan.
+    """
     n, total = cfg.n, cfg.total_ticks
     num, den = step_fraction(cfg.step_rate)
     last_start = (n - 1) * num // den
@@ -133,19 +145,45 @@ def phase_windows(cfg: SimConfig) -> PhaseWindows:
         fail_hi = fail_lo + max(total // 2, 1) - 1
         after = cfg.rejoin_after if cfg.rejoin_after is not None else 40
         rejoin_hi = fail_hi + after
+    elif cfg.wave_size > 0:
+        # the wave's radius ramp: first victim at wave_start, last at
+        # wave_last_fail (worlds.py — config-only, the seeded
+        # epicenter moves WHICH nodes, never the ticks)
+        from .. import worlds
+        fail_lo = worlds.wave_start(cfg)
+        fail_hi = worlds.wave_last_fail(cfg)
+        rejoin_hi = fail_hi + cfg.rejoin_after \
+            if cfg.rejoin_after is not None else _INF
     else:
         fail_lo = fail_hi = cfg.fail_tick
         rejoin_hi = cfg.fail_tick + cfg.rejoin_after \
             if cfg.rejoin_after is not None else _INF
-    last_join_event = last_start if rejoin_hi >= _INF \
-        else max(last_start, rejoin_hi)
+    join_events = [last_start]
+    if rejoin_hi < _INF:
+        join_events.append(rejoin_hi)
+    if cfg.flap_rate > 0:
+        # flapping members fail/rejoin inside [flap_open, flap_close];
+        # the first possible down tick is anchor + 1 >= flap_open + 1
+        from .. import worlds
+        flap_lo, flap_hi = worlds.flap_window(cfg)
+        fail_lo = min(fail_lo, flap_lo + 1)
+        rejoin_hi = max(rejoin_hi, flap_hi)
+        join_events.append(flap_hi)
+    drop_lo = cfg.drop_open_tick + 1 if cfg.drop_msg else 0
+    drop_hi = cfg.drop_close_tick if cfg.drop_msg else -1
+    if cfg.partition_groups >= 2:
+        # the partition rides the drop plane: union the two send-
+        # blocking windows (conservative single interval)
+        p_lo, p_hi = cfg.partition_open_tick + 1, cfg.partition_close_tick
+        drop_lo, drop_hi = ((min(drop_lo, p_lo), max(drop_hi, p_hi))
+                            if cfg.drop_msg else (p_lo, p_hi))
     return PhaseWindows(
         last_start=last_start,
         fail_lo=fail_lo,
         rejoin_hi=rejoin_hi,
-        join_dead_from=last_join_event + 3,
-        drop_lo=cfg.drop_open_tick + 1 if cfg.drop_msg else 0,
-        drop_hi=cfg.drop_close_tick if cfg.drop_msg else -1,
+        join_dead_from=max(join_events) + 3,
+        drop_lo=drop_lo,
+        drop_hi=drop_hi,
     )
 
 
@@ -280,4 +318,9 @@ def plan_signature(cfg: SimConfig) -> tuple:
     """
     win = phase_windows(cfg)
     return ("segplan", cfg.total_ticks, win.last_start, win.fail_lo,
-            win.rejoin_hi, win.join_dead_from, win.drop_lo, win.drop_hi)
+            win.rejoin_hi, win.join_dead_from, win.drop_lo, win.drop_hi,
+            # the adversarial worlds are part of plan identity beyond
+            # their windows (zombie/asym change tick semantics with no
+            # window of their own; flap/wave/partition knobs must not
+            # collide across distinct configs whose unions coincide)
+            cfg.worlds_key())

@@ -23,7 +23,8 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def tick_drop_masks(rng: jax.Array, t: jax.Array, n: int, active, prob):
+def tick_drop_masks(rng: jax.Array, t: jax.Array, n: int, active, prob,
+                    link_prob=None):
     """Per-tick drop decisions for all three send classes.
 
     Args:
@@ -35,14 +36,29 @@ def tick_drop_masks(rng: jax.Array, t: jax.Array, n: int, active, prob):
         300, Application.cpp:177-200, so sends during ticks [51, 300]
         are droppable.)
       prob:   f32 scalar drop probability (MSG_DROP_PROB).
+      link_prob: optional f32[N, N] per-link probability matrix
+        (sender-major; the asym_drop world, worlds.py) replacing the
+        uniform ``prob`` — the JOINREQ row uses each sender's link to
+        the introducer, the JOINREP row the introducer's link to each
+        receiver.  Same single draw, same ``lax.cond`` on the window.
 
     Returns:
       gossip_drop bool[N, N] (sender-major), joinreq_drop bool[N],
       joinrep_drop bool[N].
     """
+    if link_prob is None:
+        thr = prob
+    else:
+        from ..config import INTRODUCER
+        thr = jnp.concatenate([
+            link_prob,
+            link_prob[:, INTRODUCER][None, :],   # JOINREQ i -> intro
+            link_prob[INTRODUCER][None, :],      # JOINREP intro -> j
+        ], 0)
+
     def draw(_):
         u = jax.random.uniform(jax.random.fold_in(rng, t), (n + 2, n))
-        return u < prob
+        return u < thr
 
     drop = lax.cond(active, draw,
                     lambda _: jnp.zeros((n + 2, n), bool), None)

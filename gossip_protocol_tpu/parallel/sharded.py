@@ -48,8 +48,11 @@ def _state_specs(axis: str) -> WorldState:
 
 
 def _sched_specs() -> Schedule:
-    return Schedule(start_tick=P(), fail_tick=P(), rejoin_tick=P(),
-                    drop_active=P(), drop_prob=P())
+    import dataclasses
+    # every schedule field replicated — the (N,) vectors and world
+    # fields (worlds.py) are small next to the row-sharded tables
+    return Schedule(**{f.name: P()
+                       for f in dataclasses.fields(Schedule)})
 
 
 _SHARDED_CACHE: dict = {}

@@ -197,6 +197,7 @@ def measure_point(templates: Sequence[Template], n_requests: int,
         "span_s": round(sched.span_s, 3),
         "wall_s": round(rec["wall_s"], 3),
         "classes": dict(sorted(classes.items())),
+        "wfq_served": stats["wfq_served"],
     }
 
 
@@ -247,7 +248,8 @@ def sweep(templates: Sequence[Template], n_requests: int,
 
 def slo_ab(templates: Sequence[Template], n_requests: int,
            rate_rps: float, seed: int, slo: SLOPolicy,
-           ordering_ab: bool = True, **point_kw) -> dict:
+           ordering_ab: bool = True, wfq_ab: bool = True,
+           wfq_weights=None, **point_kw) -> dict:
     """Deadline-aware batch formation ON vs OFF on the SAME schedule
     (same seed, same classes and deadlines — only the early-flush rule
     differs).  The report's ``improved`` is the acceptance gate:
@@ -260,6 +262,15 @@ def slo_ab(templates: Sequence[Template], n_requests: int,
     ``ordering`` block compares miss rates with ordering on (the
     early-flush ON leg, which carries it) vs off.  Recorded, not
     gated: at light load both legs can tie at zero misses.
+
+    ``wfq_ab`` (PR 9 satellite) runs the SAME schedule once more with
+    per-class WEIGHTED FAIR QUEUING (``SLOPolicy.weights``, default
+    ``{"interactive": 8.0}``): the ``wfq`` block reports the
+    interactive class's latency/miss under weighted vs
+    tightest-deadline ordering plus each leg's per-class dispatched-
+    lane shares (``wfq_served``) — the measured dispatch-share shift
+    the knob buys.  Recorded, not gated, for the same light-load-tie
+    reason.
     """
     on = measure_point(templates, n_requests, rate_rps, seed, slo,
                        early_flush=True, **point_kw)
@@ -272,6 +283,33 @@ def slo_ab(templates: Sequence[Template], n_requests: int,
         "miss_rate_off": off["deadline_miss_rate"],
         "improved": on["deadline_miss_rate"] < off["deadline_miss_rate"],
     }
+    if wfq_ab:
+        ic = "interactive" if "interactive" in slo.classes \
+            else slo.default_class
+        # explicit weights pass through unfiltered so SLOPolicy
+        # validation rejects typo'd class names; the default targets
+        # whichever class ``ic`` resolved to, so the weighted leg
+        # always exercises a real weight
+        weights = dict(wfq_weights) if wfq_weights is not None \
+            else {ic: 8.0}
+        wrow = measure_point(templates, n_requests, rate_rps, seed,
+                             replace(slo, weights=weights),
+                             early_flush=True, **point_kw)
+        out["wfq"] = {
+            "weights": weights,
+            "miss_rate_weighted": wrow["deadline_miss_rate"],
+            "miss_rate_unweighted": on["deadline_miss_rate"],
+            "class_miss_weighted":
+                wrow["classes"].get(ic, {}).get("deadline_miss_rate"),
+            "class_miss_unweighted":
+                on["classes"].get(ic, {}).get("deadline_miss_rate"),
+            "class_p50_weighted":
+                wrow["classes"].get(ic, {}).get("latency_p50_s"),
+            "class_p50_unweighted":
+                on["classes"].get(ic, {}).get("latency_p50_s"),
+            "served_weighted": wrow["wfq_served"],
+            "served_unweighted": on["wfq_served"],
+        }
     if ordering_ab:
         no_order = measure_point(
             templates, n_requests, rate_rps, seed,

@@ -170,7 +170,8 @@ class FleetService:
                  slo: Optional[SLOPolicy] = None,
                  tenant_quota: Optional[int] = None,
                  pump_harvest: Optional[bool] = None,
-                 checkpoint_every: Optional[int] = None):
+                 checkpoint_every: Optional[int] = None,
+                 checkpoint_every_s: Optional[float] = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if pad_policy not in PAD_POLICIES:
@@ -185,6 +186,13 @@ class FleetService:
         if checkpoint_every is not None and checkpoint_every < 1:
             raise ValueError(f"checkpoint_every must be >= 1 or None, "
                              f"got {checkpoint_every}")
+        if checkpoint_every_s is not None and checkpoint_every_s <= 0:
+            raise ValueError(f"checkpoint_every_s must be > 0 or None, "
+                             f"got {checkpoint_every_s}")
+        if checkpoint_every is not None and checkpoint_every_s is not None:
+            raise ValueError("checkpoint_every (ticks) and "
+                             "checkpoint_every_s (seconds) are two "
+                             "spellings of one budget; set at most one")
         self.max_batch = max_batch
         self.max_wait_s = max_wait_s
         self.pad_policy = pad_policy
@@ -206,6 +214,17 @@ class FleetService:
         #: bench-mode requests are always monolithic (their program
         #: compiles the active-corner width whole-run).
         self.checkpoint_every = checkpoint_every
+        #: wall-clock-triggered checkpoints (ROADMAP PR-8 follow-on):
+        #: a SECONDS budget converted to a tick budget per bucket via
+        #: the measured wall-seconds-per-tick EWMA (``_tick_wall``,
+        #: seeded by ``warm()``, updated on every dispatch from CLOCK
+        #: deltas — the injected ``clock``, so a virtual/fake clock
+        #: keeps the budget a deterministic pure function of the clock
+        #: program) and then snapped to a legal PR-1 segment cut by
+        #: ``cut_for_budget`` exactly like the tick spelling.  A
+        #: bucket with no wall measurement yet dispatches monolithic
+        #: (warm() seeds the estimate, so warmed buckets never do).
+        self.checkpoint_every_s = checkpoint_every_s
         self.clock = clock
         self.cache = ProgramCache(block_size=block_size,
                                   chunk_ticks=chunk_ticks, mesh=mesh,
@@ -287,10 +306,18 @@ class FleetService:
         # admission is O(1) instead of a full queue scan per submit
         self._tenant_queued: dict[str, int] = {}
         self._early_flushes = 0
+        # WFQ service counters (slo.weights): lanes dispatched per
+        # class, the deficit the pump order normalizes by weight
+        self._wfq_served: dict[str, float] = {}
         # per-bucket dispatch-wall EWMA (seconds), seeded by warm():
         # the early-flush estimate (PR 6's wall decomposition already
         # measures the wall; this just remembers it per bucket)
         self._bucket_wall: dict[tuple, float] = {}
+        # per-BASE-bucket wall-seconds-per-TICK EWMA, from clock()
+        # deltas around each dispatch (so a virtual clock keeps it
+        # deterministic); the checkpoint_every_s -> tick-budget
+        # conversion reads it
+        self._tick_wall: dict[tuple, float] = {}
         # failure-domain counters (lifetime-exact, like the request/
         # dispatch counters; the windowed view rides the _dispatches
         # entries' "retries" field)
@@ -465,15 +492,28 @@ class FleetService:
         batch could sit a full dispatch wall behind a deadline-less
         bulk bucket that merely enqueued earlier.  Deadline-less
         buckets keep FIFO order after every deadline-carrying one.
-        Deterministic: deadlines are pure schedule values on a virtual
-        clock and ties break on creation order, so digest replays are
-        unaffected (tests/test_traffic.py).
+        With ``slo.weights`` set (PR 9 satellite), WEIGHTED FAIR
+        QUEUING replaces tightest-first: buckets order by their
+        dominant class's normalized service deficit (lanes dispatched
+        so far / weight, least-served first), so a heavy class earns
+        a proportional share of dispatch slots without starving light
+        ones.  Deterministic either way: deadlines/weights are pure
+        schedule values on a virtual clock and ties break on creation
+        order, so digest replays are unaffected
+        (tests/test_traffic.py).
         """
         keys = list(self._queues)
         if self.slo is None \
                 or not getattr(self.slo, "class_ordering", True):
             return keys
         pos = {k: i for i, k in enumerate(keys)}
+        if getattr(self.slo, "weights", None) is not None:
+            def deficit(k):
+                cls = self._dominant_class(self._queues[k])
+                served = self._wfq_served.get(cls, 0.0)
+                return (served / self.slo.weight_of(cls), pos[k])
+            keys.sort(key=deficit)
+            return keys
 
         def tightness(k):
             dls = [r.deadline_s for r in self._queues[k]
@@ -482,6 +522,18 @@ class FleetService:
 
         keys.sort(key=tightness)
         return keys
+
+    def _dominant_class(self, q) -> str:
+        """The WFQ class a bucket is charged to: the priority class
+        holding the most queued requests (ties break on class name —
+        deterministic)."""
+        counts: dict[str, int] = {}
+        for r in q:
+            counts[r.priority] = counts.get(r.priority, 0) + 1
+        if not counts:
+            return self.slo.default_class if self.slo is not None \
+                else "default"
+        return max(sorted(counts), key=lambda c: counts[c])
 
     def _harvest_enabled(self) -> bool:
         """Whether an idle ``pump()`` may resolve a ready in-flight
@@ -604,18 +656,48 @@ class FleetService:
         All lanes of a batch share the plan (the bucket pins the plan
         signature, and cuts are seed-independent), so one leg length
         serves the whole batch."""
-        if self.checkpoint_every is None:
-            return None
         r0 = reqs[0]
         cfg = r0.cfg
+        budget = self.checkpoint_every
+        if budget is None and self.checkpoint_every_s is not None:
+            budget = self._ticks_for_seconds(self._base_key(r0.bucket))
+        if budget is None:
+            if r0.resume is not None:
+                # a resumed batch must take the leg path (its carry
+                # lives in checkpoints) even if the seconds budget has
+                # no estimate yet: run it to the end in one leg
+                return cfg.total_ticks - r0.resume.tick
+            return None
         if cfg.model != "overlay" and r0.mode != "trace":
             return None     # dense bench: monolithic by construction
         start = r0.resume.tick if r0.resume is not None else 0
-        end = cut_for_budget(cfg, start, self.checkpoint_every)
+        end = cut_for_budget(cfg, start, budget)
         if r0.resume is None and end >= cfg.total_ticks:
             return None     # no interior cut (or the run fits the
             #                 budget): nothing to checkpoint
         return end - start
+
+    def _ticks_for_seconds(self, base: tuple) -> Optional[int]:
+        """The seconds budget as ticks, via the bucket's measured
+        wall-per-tick EWMA (falling back to the cross-bucket mean);
+        None until any estimate exists — an unwarmed bucket's first
+        dispatch runs monolithic rather than guessing."""
+        spt = self._tick_wall.get(base)
+        if spt is None and self._tick_wall:
+            spt = sum(self._tick_wall.values()) / len(self._tick_wall)
+        if spt is None or spt <= 0.0:
+            return None
+        return max(1, int(self.checkpoint_every_s / spt))
+
+    def _note_tick_wall(self, base: tuple, wall_s: float,
+                        ticks: int) -> None:
+        if ticks <= 0 or wall_s < 0.0:
+            return
+        alpha = self.slo.wall_ewma_alpha if self.slo is not None else 0.3
+        prev = self._tick_wall.get(base)
+        spt = wall_s / ticks
+        self._tick_wall[base] = spt if prev is None \
+            else (1.0 - alpha) * prev + alpha * spt
 
     def _width(self, k: int) -> int:
         """Compiled lane width for a ``k``-request batch.
@@ -652,6 +734,8 @@ class FleetService:
         reqs = [q.popleft() for _ in range(min(len(q), self.capacity))]
         for r in reqs:
             self._tenant_note(r.tenant, -1)
+            self._wfq_served[r.priority] = \
+                self._wfq_served.get(r.priority, 0.0) + 1.0
         try:
             if self.pipeline:
                 self._serve_batch_pipelined(key, reqs)
@@ -1051,6 +1135,12 @@ class FleetService:
         # the base bucket's monolithic dispatch wall
         self._bucket_wall[key] = wall if prev is None \
             else (1.0 - alpha) * prev + alpha * wall
+        # wall-per-tick from CLOCK deltas (checkpoint_every_s): this
+        # leg ran [prev cut, new cut) ticks
+        leg_start = reqs[0].resume.tick if reqs[0].resume is not None \
+            else 0
+        self._note_tick_wall(base, self.clock() - t_q0,
+                             leg.checkpoints[0].tick - leg_start)
         sub = base + (("resume", leg.checkpoints[0].tick),)
         q = self._queues.setdefault(sub, deque())
         for req, ck in zip(reqs, leg.checkpoints):
@@ -1114,6 +1204,10 @@ class FleetService:
         prev = self._bucket_wall.get(key)
         self._bucket_wall[key] = leg_wall if prev is None \
             else (1.0 - alpha) * prev + alpha * leg_wall
+        leg_start = reqs[0].resume.tick if reqs[0].resume is not None \
+            else 0
+        self._note_tick_wall(base, now - t_q0,
+                             reqs[0].cfg.total_ticks - leg_start)
         for req, lane in zip(reqs, fleet.lanes):
             missed = req.deadline_s is not None and now > req.deadline_s
             if missed:
@@ -1344,6 +1438,7 @@ class FleetService:
         width = self._width(self.capacity)
         padded = pad_configs([cfg], width, cfg)
         builds0 = run_build_count()
+        c_warm0 = self.clock()
         first_leg = None
         if self.checkpoint_every is not None \
                 and (cfg.model == "overlay" or mode == "trace"):
@@ -1377,6 +1472,40 @@ class FleetService:
         # which errs CONSERVATIVE (flush earlier than strictly needed)
         # and the EWMA converges within a few live dispatches
         self._bucket_wall.setdefault(key, wall)
+        # seed the wall-per-tick estimate for checkpoint_every_s from
+        # CLOCK deltas (deterministic under a virtual clock); the
+        # just-compiled inflation again errs conservative — shorter
+        # first legs, converging within a few dispatches
+        self._tick_wall.setdefault(
+            key, max(self.clock() - c_warm0, 0.0)
+            / max(cfg.total_ticks, 1))
+        if self.checkpoint_every is None \
+                and self.checkpoint_every_s is not None \
+                and (cfg.model == "overlay" or mode == "trace"):
+            # the seconds budget resolves to ticks only AFTER this
+            # warm seeded the wall-per-tick estimate — warm the same
+            # leg chain the first live dispatch will now run, so a
+            # warmed seconds-budget bucket neither compiles leg
+            # programs in-band nor folds compile time into its first
+            # EWMA samples.  (Later dispatches may re-quantize to a
+            # different cut as the EWMA converges; cuts are few, so
+            # the chain covers the common lengths.)
+            budget = self._ticks_for_seconds(self._base_key(key))
+            end0 = cut_for_budget(cfg, 0, budget) \
+                if budget is not None else cfg.total_ticks
+            if end0 < cfg.total_ticks:
+                builds1 = run_build_count()
+                leg = sim.run_leg(configs=padded, n_real=1,
+                                  ticks=end0, mode=mode)
+                while not leg.done:
+                    nxt = cut_for_budget(cfg, leg.checkpoints[0].tick,
+                                         budget)
+                    leg = sim.run_leg(
+                        resume=leg.checkpoints,
+                        ticks=nxt - leg.checkpoints[0].tick,
+                        width=width)
+                self._bucket_stats[key]["builds"] += \
+                    run_build_count() - builds1
 
     def stats(self) -> dict:
         """Service-level serving metrics (the BENCH json schema).
@@ -1459,12 +1588,14 @@ class FleetService:
             # dispatches and per-tenant admission shedding
             "slo_early_flushes": self._early_flushes,
             "tenant_shed": dict(sorted(self._tenant_shed.items())),
+            "wfq_served": dict(sorted(self._wfq_served.items())),
             # the elasticity plane (PR 8): mesh grows, segment-
             # boundary checkpoints, lane migrations across mesh
             # rebuilds, resume dispatches, and the restarted-from-
             # tick-0 counter the elastic replay gate pins to 0
             "elastic": dict(self._elastic),
             "checkpoint_every": self.checkpoint_every,
+            "checkpoint_every_s": self.checkpoint_every_s,
         }
         # per-priority-class view: each class's OWN windowed
         # percentiles + lifetime terminal counters (completed counts

@@ -90,6 +90,20 @@ class SLOPolicy:
     #: creation order), so virtual-clock replays stay digest-stable.
     #: ``False`` is the A/B leg (loadbench.slo_ab ``ordering_ab``).
     class_ordering: bool = True
+    #: per-class WEIGHTED FAIR QUEUING between SLO classes (ROADMAP
+    #: PR-7 follow-on; PR 9 satellite): ``{class: weight}`` — when
+    #: set, ``pump()`` orders buckets by normalized service deficit
+    #: (lanes already dispatched for the bucket's dominant class,
+    #: divided by that class's weight; least-served-per-weight first)
+    #: instead of tightest-deadline-first, so a heavy class gets a
+    #: proportionally larger share of dispatch slots under sustained
+    #: mixed load while light classes can never be starved outright.
+    #: Classes absent from the mapping inherit their ``ClassPolicy``
+    #: weight.  Deterministic (ties break on bucket creation order) —
+    #: virtual-clock replays stay digest-stable.  None (default):
+    #: tightest-deadline-first ordering, the PR 8 behavior and the
+    #: A/B leg (loadbench ``slo_ab["wfq"]``).
+    weights: Optional[Mapping[str, float]] = None
     #: the dispatch-wall estimate is multiplied by this before being
     #: compared against the deadline margin — headroom for the
     #: estimate being an EWMA of a noisy wall
@@ -114,6 +128,16 @@ class SLOPolicy:
         if not 0.0 < self.wall_ewma_alpha <= 1.0:
             raise ValueError(f"wall_ewma_alpha must be in (0, 1], got "
                              f"{self.wall_ewma_alpha}")
+        if self.weights is not None:
+            unknown = set(self.weights) - set(self.classes)
+            if unknown:
+                raise ValueError(
+                    f"weights name unknown classes {sorted(unknown)}; "
+                    f"expected a subset of {sorted(self.classes)}")
+            if any(w <= 0 for w in self.weights.values()):
+                raise ValueError("WFQ weights must be > 0 (a zero "
+                                 "weight would starve the class "
+                                 "outright; leave it out instead)")
 
     def resolve(self, priority: Optional[str]) -> str:
         """Validate (or default) a submitted priority name."""
@@ -133,6 +157,20 @@ class SLOPolicy:
 
     def with_early_flush(self, enabled: bool) -> "SLOPolicy":
         return replace(self, early_flush=enabled)
+
+    def with_weights(self, weights: Optional[Mapping[str, float]]
+                     ) -> "SLOPolicy":
+        return replace(self, weights=weights)
+
+    def weight_of(self, priority: str) -> float:
+        """Effective WFQ weight of a class: the ``weights`` entry when
+        present, else its ClassPolicy weight (floored at a small
+        positive value so an unlisted zero-weight class is still
+        schedulable)."""
+        if self.weights is not None and priority in self.weights:
+            return float(self.weights[priority])
+        return max(float(self.classes[priority].weight), 1e-6) \
+            if priority in self.classes else 1.0
 
 
 def default_slo(scale: float = 1.0, early_flush: bool = True,

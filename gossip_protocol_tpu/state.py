@@ -88,6 +88,36 @@ class Schedule:
                             #          sentinel = stays dead
     drop_active: jax.Array  # bool[T] — dropmsg flag value during tick t's sends
     drop_prob: jax.Array    # f32 scalar — MSG_DROP_PROB
+    # --- adversarial failure worlds (worlds.py); every field below is
+    # --- inert data (zeros / empty) when its world is off ---
+    part_group: jax.Array   # i32[N] — hashed partition group per node
+    part_on: jax.Array      # bool scalar — partition world configured
+    part_open: jax.Array    # i32 — cross-group sends blocked:
+    part_close: jax.Array   # i32   open < t <= close
+    link_prob: jax.Array    # f32[N, N] per-link drop probability
+                            #   (sender-major; f32[0, 0] when asym off —
+                            #   the tick branches statically on the cfg)
+    flap_mask: jax.Array    # bool[N] — which nodes flap
+    flap_phase: jax.Array   # i32[N] — absolute cycle anchor per node
+    flap_period: jax.Array  # i32 scalar
+    flap_down: jax.Array    # i32 scalar — down ticks per period
+    flap_close: jax.Array   # i32 scalar — last tick a cycle may end at
+
+    def _flap_state(self, t: jax.Array):
+        """(failed, rejoining) bool[N] under the flap world: a flapper
+        is down for positions [1, flap_down] of every cycle from its
+        anchor and rejoins (fresh-nodeStart wipe, like churn) at
+        position flap_down — only for cycles completing before
+        ``flap_close``, so the window always ends clean."""
+        per = jnp.maximum(self.flap_period, 1)
+        pos = t - self.flap_phase
+        c = pos // per
+        off = pos - c * per
+        ok = self.flap_mask & (pos >= 1) \
+            & (self.flap_phase + c * per + self.flap_down
+               <= self.flap_close)
+        return (ok & (off >= 1) & (off <= self.flap_down),
+                ok & (off == self.flap_down))
 
     def failed_at(self, t: jax.Array) -> jax.Array:
         """bool[N]: is peer i failed while processing tick ``t``?
@@ -96,9 +126,29 @@ class Schedule:
         (Application.cpp:99-104,181-196), so the flag is observed from
         tick ``fail_tick + 1`` on.  A churned peer is failed only for
         the window ``fail_tick < t <= rejoin_tick`` (its rejoin acts
-        like a fresh ``nodeStart`` at ``rejoin_tick``).
+        like a fresh ``nodeStart`` at ``rejoin_tick``).  Flapping
+        members (worlds.py) add their periodic down phases on top.
         """
+        f, _ = self._flap_state(t)
+        return ((t > self.fail_tick) & (t <= self.rejoin_tick)) | f
+
+    def window_failed_at(self, t: jax.Array) -> jax.Array:
+        """bool[N]: the WINDOW component of :meth:`failed_at` alone
+        (scripted / churn / wave — no flap).  The zombie world applies
+        to exactly these failures: a zombie keeps gossiping its frozen
+        table through its whole fail window, while a flap down-phase
+        is an ordinary silence."""
         return (t > self.fail_tick) & (t <= self.rejoin_tick)
+
+    def rejoining_at(self, t: jax.Array) -> jax.Array:
+        """bool[N]: peers wiped and re-introduced at tick ``t`` (the
+        churn/rejoin path, plus every flap up-edge)."""
+        _, r = self._flap_state(t)
+        return (t == self.rejoin_tick) | r
+
+    def part_active_at(self, t: jax.Array) -> jax.Array:
+        """bool scalar: are cross-group sends blocked at tick ``t``?"""
+        return self.part_on & (t > self.part_open) & (t <= self.part_close)
 
 
 NEVER = np.iinfo(np.int32).max  # sentinel fail_tick for peers that never fail
@@ -131,16 +181,23 @@ def make_schedule_host(cfg: SimConfig) -> Schedule:
     """
     from .utils.prng import fail_schedule_uniform
 
+    from . import worlds
+
     n = cfg.n
     start = np.array([cfg.start_tick(i) for i in range(n)], np.int32)
-    fail = np.full(n, NEVER, np.int32)
-    u = fail_schedule_uniform(cfg.seed)
-    if cfg.single_failure:
-        victim = int(u * n) % n
-        fail[victim] = cfg.fail_tick
+    if cfg.wave_size > 0:
+        # correlated failure wave: a seeded epicenter + radius ramp
+        # replaces the scripted single/multi draw (worlds.py)
+        fail = worlds.wave_fail_ticks(cfg)
     else:
-        r = (int(u * n) % n) // 2
-        fail[r: r + n // 2] = cfg.fail_tick
+        fail = np.full(n, NEVER, np.int32)
+        u = fail_schedule_uniform(cfg.seed)
+        if cfg.single_failure:
+            victim = int(u * n) % n
+            fail[victim] = cfg.fail_tick
+        else:
+            r = (int(u * n) % n) // 2
+            fail[r: r + n // 2] = cfg.fail_tick
     rejoin = np.full(n, NEVER, np.int32)
     if cfg.rejoin_after is not None:
         if cfg.rejoin_after < 1:
@@ -154,12 +211,24 @@ def make_schedule_host(cfg: SimConfig) -> Schedule:
     drop = np.zeros(cfg.total_ticks, bool)
     if cfg.drop_msg:
         drop = (t > cfg.drop_open_tick) & (t <= cfg.drop_close_tick)
+    part_open, part_close = worlds.partition_window(cfg)
+    _, flap_close = worlds.flap_window(cfg)
     return Schedule(
         start_tick=start,
         fail_tick=fail,
         rejoin_tick=rejoin,
         drop_active=drop,
         drop_prob=np.float32(cfg.msg_drop_prob),
+        part_group=worlds.partition_groups_host(cfg),
+        part_on=np.bool_(cfg.partition_groups >= 2),
+        part_open=np.int32(part_open),
+        part_close=np.int32(part_close),
+        link_prob=worlds.link_prob_host(cfg),
+        flap_mask=worlds.flap_mask_host(cfg),
+        flap_phase=worlds.flap_anchor_host(cfg),
+        flap_period=np.int32(max(cfg.flap_period, 1)),
+        flap_down=np.int32(cfg.flap_down),
+        flap_close=np.int32(flap_close if cfg.flap_rate > 0 else -1),
     )
 
 
@@ -171,13 +240,21 @@ def make_schedule(cfg: SimConfig) -> Schedule:
     the schedule inside traced code keep working.
     """
     s = make_schedule_host(cfg)
-    return Schedule(
-        start_tick=jnp.asarray(s.start_tick),
-        fail_tick=jnp.asarray(s.fail_tick),
-        rejoin_tick=jnp.asarray(s.rejoin_tick),
-        drop_active=jnp.asarray(s.drop_active),
-        drop_prob=jnp.float32(cfg.msg_drop_prob),
-    )
+    return jax.tree.map(jnp.asarray, s)
+
+
+def slice_schedule(s: Schedule, a: int) -> Schedule:
+    """Width-``a`` view of the per-node schedule fields (window
+    scalars shared) — the active-corner paths (core/dense_corner.py,
+    the fleet bench staging) run on the leading ``a``-peer block, so
+    their schedules slice the same block.  The corner is gated off for
+    world configs (dense_corner.active_bound), so the world fields
+    sliced here are always inert."""
+    return s.replace(
+        start_tick=s.start_tick[:a], fail_tick=s.fail_tick[:a],
+        rejoin_tick=s.rejoin_tick[:a],
+        part_group=s.part_group[:a], link_prob=s.link_prob[:a, :a],
+        flap_mask=s.flap_mask[:a], flap_phase=s.flap_phase[:a])
 
 
 

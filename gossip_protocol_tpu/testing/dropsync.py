@@ -12,25 +12,48 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-from ..config import SimConfig
+from ..config import INTRODUCER, SimConfig
 from ..state import Schedule
 
 
 def make_drop_masks(cfg: SimConfig, sched: Schedule):
     """Returns (gossip_drop[T,N,N], joinreq_drop[T,N], joinrep_drop[T,N])
-    boolean numpy arrays: True = that send would be dropped."""
+    boolean numpy arrays: True = that send would be dropped.
+
+    Covers the adversarial worlds that ride the drop plane (worlds.py)
+    exactly as the tick applies them: the asym world swaps the uniform
+    threshold for the per-link matrix inside the same windowed draw,
+    and the partition world ORs its deterministic cross-group mask in
+    outside the window cond — so an oracle consuming these masks sees
+    the byte-identical decisions."""
     n, t_total = cfg.n, cfg.total_ticks
     base = jax.random.PRNGKey(cfg.seed)
     active = np.asarray(sched.drop_active)
-    p = float(sched.drop_prob)
+    lp = np.asarray(sched.link_prob)
+    if lp.size:
+        # the tick's concatenated threshold rows: gossip links, then
+        # JOINREQ i -> introducer, then JOINREP introducer -> j
+        thr = np.concatenate([lp, lp[:, INTRODUCER][None, :],
+                              lp[INTRODUCER][None, :]], 0)
+    else:
+        thr = float(sched.drop_prob)
 
     g = np.zeros((t_total, n, n), bool)
     q = np.zeros((t_total, n), bool)
     r = np.zeros((t_total, n), bool)
-    draw = jax.jit(lambda k: jax.random.uniform(k, (n + 2, n)) < p)
+    draw = jax.jit(lambda k: jax.random.uniform(k, (n + 2, n)) < thr)
     for t in range(t_total):
         if not active[t]:
             continue
         drop = np.asarray(draw(jax.random.fold_in(base, t)))
         g[t], q[t], r[t] = drop[:n], drop[n], drop[n + 1]
+    if bool(sched.part_on):
+        grp = np.asarray(sched.part_group)
+        cross = grp[:, None] != grp[None, :]
+        po, pc = int(sched.part_open), int(sched.part_close)
+        for t in range(t_total):
+            if po < t <= pc:
+                g[t] |= cross
+                q[t] |= cross[:, INTRODUCER]
+                r[t] |= cross[INTRODUCER]
     return g, q, r

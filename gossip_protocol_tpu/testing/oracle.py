@@ -58,7 +58,7 @@ class ReferenceOracle:
 
     def __init__(self, cfg: SimConfig, start_tick, fail_tick,
                  gossip_drop=None, joinreq_drop=None, joinrep_drop=None,
-                 rejoin_tick=None):
+                 rejoin_tick=None, flap_state=None):
         self.cfg = cfg
         n = cfg.n
         self.n = n
@@ -70,6 +70,12 @@ class ReferenceOracle:
         self.gossip_drop = gossip_drop
         self.joinreq_drop = joinreq_drop
         self.joinrep_drop = joinrep_drop
+        # adversarial worlds (worlds.py): zombie rides cfg.zombie; the
+        # flap world injects ``flap_state(i, t) -> (failed, rejoining)``
+        # (worlds.make_flap_state) — periodic down phases on top of the
+        # window schedule, with every up-edge a fresh-nodeStart rejoin
+        self.zombie = bool(cfg.zombie)
+        self.flap_state = flap_state
 
         self.t = 0
         self.in_group = np.zeros(n, bool)
@@ -82,9 +88,23 @@ class ReferenceOracle:
         self.events = OracleEvents()
 
     # --- helpers ----------------------------------------------------
+    def window_failed(self, i, t=None) -> bool:
+        """The scripted/churn/wave fail-WINDOW component alone — the
+        failures the zombie world applies to."""
+        t = self.t if t is None else t
+        return t > self.fail_tick[i] and t <= self.rejoin_tick[i]
+
     def failed(self, i) -> bool:
-        """Churn extension: failed only inside (fail, rejoin]."""
-        return self.t > self.fail_tick[i] and self.t <= self.rejoin_tick[i]
+        """Churn extension: failed only inside (fail, rejoin]; flapping
+        members add their periodic down phases on top."""
+        if self.window_failed(i):
+            return True
+        return self.flap_state is not None \
+            and self.flap_state(i, self.t)[0]
+
+    def flap_rejoining(self, i) -> bool:
+        return self.flap_state is not None \
+            and self.flap_state(i, self.t)[1]
 
     def find(self, i, peer):
         for e in self.lists[i]:
@@ -131,12 +151,17 @@ class ReferenceOracle:
             self.add_member(i, msg.src, 1, t)
             self.in_group[i] = True
         elif msg.kind == GOSSIP:
-            e = self.find(i, msg.src)
-            if e is not None:
-                e.hb += 1
-                e.ts = t
-            else:
-                self.add_member(i, msg.src, 1, t)
+            # zombie world: a message from a window-failed sender
+            # carries a FROZEN heartbeat — an old observation, not
+            # proof of life — so the direct-sender credit is skipped;
+            # its stale payload still merges by the ordinary rules
+            if not (self.zombie and self.window_failed(msg.src, t - 1)):
+                e = self.find(i, msg.src)
+                if e is not None:
+                    e.hb += 1
+                    e.ts = t
+                else:
+                    self.add_member(i, msg.src, 1, t)
             for inc in msg.payload:
                 node = self.find(i, inc.peer)
                 if node is not None:
@@ -174,20 +199,25 @@ class ReferenceOracle:
         # (EmulNet.cpp:151) — removing them would perturb the swap-pop
         # consumption order for everyone else without any observable
         # protocol effect.
-        if (self.rejoin_tick != NEVER).any():
-            self.buffer = [m for m in self.buffer
-                           if not (self.failed(m.dst)
-                                   and self.rejoin_tick[m.dst] != NEVER)]
+        if (self.rejoin_tick != NEVER).any() or self.flap_state is not None:
+            self.buffer = [
+                m for m in self.buffer
+                if not ((self.window_failed(m.dst)
+                         and self.rejoin_tick[m.dst] != NEVER)
+                        or (self.flap_state is not None
+                            and self.flap_state(m.dst, self.t)[0]))]
         # phase A: forward order receive
         for i in range(n):
             if t > self.start_tick[i] and not self.failed(i):
                 self.recv_loop(i)
         # phase B: reverse order introduce / nodeLoop
         for i in range(n - 1, -1, -1):
-            if t == self.start_tick[i] or t == self.rejoin_tick[i]:
+            if t == self.start_tick[i] or t == self.rejoin_tick[i] \
+                    or self.flap_rejoining(i):
                 # nodeStart (MP1Node.cpp:67-154); a churned peer's
-                # rejoin re-initializes like initThisNode first
-                if t == self.rejoin_tick[i]:
+                # rejoin — and every flap up-edge — re-initializes
+                # like initThisNode first
+                if t == self.rejoin_tick[i] or self.flap_rejoining(i):
                     self.lists[i] = []
                     self.queues[i] = []
                     self.in_group[i] = False
@@ -206,6 +236,17 @@ class ReferenceOracle:
                     self.handle(i, msg)
                 if self.in_group[i]:
                     self.node_loop_ops(i)
+            elif self.zombie and self.window_failed(i) and self.in_group[i]:
+                # zombie world: a window-failed in-group peer keeps
+                # gossiping its FROZEN table — no inbox drain, no
+                # heartbeat increment, no removal scan, just the
+                # full-list sends with the list frozen at its fail tick
+                for e in list(self.lists[i]):
+                    g = Msg(GOSSIP, i, e.peer,
+                            [dataclasses.replace(x) for x in self.lists[i]])
+                    dropped = bool(self.gossip_drop[t, i, e.peer]) \
+                        if self.gossip_drop is not None else False
+                    self.send(g, dropped)
         self.t += 1
 
     def run(self, ticks=None):

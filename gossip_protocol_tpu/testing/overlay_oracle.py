@@ -23,6 +23,7 @@ from ..models.overlay import (ID_BITS, SLOT_EPOCH, _SALT_CHURN,
                               _pack_th, degree_thresholds, resolved_dims)
 from ..state import NEVER
 from ..utils.hash32 import mix32, threshold32
+from .. import worlds
 
 U = np.uint32
 
@@ -58,6 +59,21 @@ class OverlayOracle:
         self.churn_after = (cfg.rejoin_after if cfg.rejoin_after is not None
                             else 40)
 
+        # --- adversarial failure worlds (worlds.py) -----------------
+        self.part_groups = worlds.partition_groups_host(cfg)
+        self.part_on = cfg.partition_groups >= 2
+        self.part_open, self.part_close = worlds.partition_window(cfg)
+        self.asym = bool(cfg.asym_drop)
+        self.wave_fail = (worlds.wave_fail_ticks(cfg)
+                          if cfg.wave_size > 0 else None)
+        self.zombie = bool(cfg.zombie)
+        self.flap = cfg.flap_rate > 0
+        self.flap_mask = worlds.flap_mask_host(cfg)
+        self.flap_anchor = worlds.flap_anchor_host(cfg)
+        self.flap_per = max(cfg.flap_period, 1)
+        self.flap_down = cfg.flap_down
+        _, self.flap_hi = worlds.flap_window(cfg)
+
         self.t = 0
         self.ids = np.full((n, self.k), -1, np.int32)
         self.hb = np.zeros((n, self.k), np.int32)
@@ -79,6 +95,10 @@ class OverlayOracle:
                 return NEVER
             return self.churn_lo + int(
                 mix32(self.seed, U(i), U(_SALT_CHURN_TICK))) % self.churn_span
+        if self.wave_fail is not None:
+            # correlated failure wave: seeded epicenter + radius ramp
+            # replaces the scripted draw (worlds.py)
+            return int(self.wave_fail[i])
         return (self.cfg.fail_tick
                 if self.victim_lo <= i < self.victim_hi else NEVER)
 
@@ -87,12 +107,50 @@ class OverlayOracle:
         after = self.churn_after if self.churn_thr > 0 else self.rejoin_after
         return fail + after if (fail != NEVER and after != NEVER) else NEVER
 
-    def failed(self, i, t):
+    def flap_state(self, i, t):
+        """(failed, rejoining) under the flap world (worlds.py
+        flap_state_host semantics, from the precomputed arrays)."""
+        if not self.flap or not bool(self.flap_mask[i]):
+            return False, False
+        anchor = int(self.flap_anchor[i])
+        pos = t - anchor
+        if pos < 1:
+            return False, False
+        c = pos // self.flap_per
+        off = pos - c * self.flap_per
+        if anchor + c * self.flap_per + self.flap_down > self.flap_hi:
+            return False, False
+        return (1 <= off <= self.flap_down), off == self.flap_down
+
+    def window_failed(self, i, t):
+        """The scripted/churn/wave fail-window component alone — the
+        failures the zombie world applies to."""
         return self.fail_of(i) < t <= self.rejoin_of(i)
+
+    def failed(self, i, t):
+        return self.window_failed(i, t) or self.flap_state(i, t)[0]
+
+    def rejoining(self, i, t):
+        return self.rejoin_of(i) == t or self.flap_state(i, t)[1]
 
     def drop_active(self, t):
         return (self.cfg.drop_msg
                 and self.cfg.drop_open_tick < t <= self.cfg.drop_close_tick)
+
+    def part_active(self, t):
+        return self.part_on and self.part_open < t <= self.part_close
+
+    def cross_group(self, i, j):
+        return self.part_on and \
+            int(self.part_groups[i]) != int(self.part_groups[j])
+
+    def link_thr(self, i, j):
+        """Per-link drop threshold of link i -> j (asym world): mean
+        ``drop_thr``, uniform in [0, 2*thr) — the i*N+j hash input
+        wraps in uint32 exactly like the device path."""
+        two = (U(self.drop_thr) * U(2)) & U(0xFFFFFFFF)
+        h = int(mix32(self.seed, U(i) * U(self.n) + U(j), U(worlds.SALT_LINK)))
+        return h % max(int(two), 1)
 
     # --- protocol pieces --------------------------------------------
     def slot(self, epoch, j):
@@ -123,7 +181,7 @@ class OverlayOracle:
         epoch = t // SLOT_EPOCH          # layout of all tables this tick
         proc = np.array([t > self.start_of(i) and not self.failed(i, t)
                          for i in range(n)])
-        rejoining = np.array([self.rejoin_of(i) == t for i in range(n)])
+        rejoining = np.array([self.rejoining(i, t) for i in range(n)])
 
         # churn wipe
         for i in np.flatnonzero(rejoining):
@@ -150,6 +208,11 @@ class OverlayOracle:
                         cands[r].append((q, int(self.ids[p, q]),
                                          int(self.hb[p, q]),
                                          int(self.ts[p, q]), False))
+                if self.zombie and self.window_failed(p, t - 1):
+                    # zombie world: a window-failed sender's message
+                    # carries a FROZEN heartbeat — no direct self-entry
+                    # credit; its stale table rows merged above
+                    continue
                 cands[r].append((self.slot(epoch, p), p,
                                  int(self.own_hb[p]), t - 1, True))
 
@@ -161,8 +224,9 @@ class OverlayOracle:
                     cands[r].append((q, int(self.ids[INTRODUCER, q]),
                                      int(self.hb[INTRODUCER, q]),
                                      int(self.ts[INTRODUCER, q]), False))
-            cands[r].append((self.slot(epoch, INTRODUCER), INTRODUCER,
-                             int(self.own_hb[INTRODUCER]), t - 1, True))
+            if not (self.zombie and self.window_failed(INTRODUCER, t - 1)):
+                cands[r].append((self.slot(epoch, INTRODUCER), INTRODUCER,
+                                 int(self.own_hb[INTRODUCER]), t - 1, True))
             recv += 1
         in_group = self.in_group | jrep
 
@@ -213,16 +277,25 @@ class OverlayOracle:
         starting = np.array([self.start_of(i) == t for i in range(n)]) | rejoining
         in_group = in_group | (starting & (np.arange(n) == INTRODUCER))
         active = self.drop_active(t)
+        part = self.part_active(t)
         joinreq_sent = np.zeros(n, bool)
         for i in np.flatnonzero(starting):
             if i != INTRODUCER:
+                thr = self.link_thr(i, INTRODUCER) if self.asym \
+                    else self.drop_thr
                 drop = active and int(mix32(self.seed, U(t), U(i),
-                                            U(_SALT_JOINREQ_DROP))) < self.drop_thr
+                                            U(_SALT_JOINREQ_DROP))) < thr
+                if part and self.cross_group(i, INTRODUCER):
+                    drop = True
                 joinreq_sent[i] = not drop
         joinrep_sent = np.zeros(n, bool)
         for j in np.flatnonzero(jreq):
+            thr = self.link_thr(INTRODUCER, j) if self.asym \
+                else self.drop_thr
             drop = active and int(mix32(self.seed, U(t), U(j),
-                                        U(_SALT_JOINREP_DROP))) < self.drop_thr
+                                        U(_SALT_JOINREP_DROP))) < thr
+            if part and self.cross_group(INTRODUCER, j):
+                drop = True
             joinrep_sent[j] = not drop
 
         # detection
@@ -265,17 +338,29 @@ class OverlayOracle:
                     rm_hb[r, sl] = (p & 0xFFF) - 1
             new_ids, new_hb, new_ts = rm_ids, rm_hb, rm_ts
 
-        # dissemination: in-flight flags for the next tick
+        # dissemination: in-flight flags for the next tick.  Zombie
+        # world: window-failed in-group peers keep gossiping their
+        # frozen tables (self.in_group is still the pre-update vector
+        # here — a window-failed peer cannot have joined this tick)
         new_flags = np.zeros((n, f), bool)
         sent = int(joinreq_sent.sum()) + int(joinrep_sent.sum())
-        for r in np.flatnonzero(ops):
+        send_rows = set(np.flatnonzero(ops))
+        if self.zombie:
+            send_rows |= {i for i in range(n)
+                          if self.window_failed(i, t) and self.in_group[i]}
+        for r in sorted(send_rows):
             deg = f
             if self.cfg.topology == "powerlaw":
                 du = int(mix32(self.seed, U(r), U(_SALT_DEGREE)))
                 deg = 1 + sum(1 for thr in self.deg_thr if du < int(thr))
             for fi in range(deg):
+                partner = r ^ self.mask(t, fi)
+                thr = self.link_thr(r, partner) if self.asym \
+                    else self.drop_thr
                 gdrop = active and int(mix32(self.seed, U(t), U(r), U(fi),
-                                             U(_SALT_GOSSIP_DROP))) < self.drop_thr
+                                             U(_SALT_GOSSIP_DROP))) < thr
+                if part and self.cross_group(r, partner):
+                    gdrop = True
                 if not gdrop:
                     new_flags[r, fi] = True
                     sent += 1

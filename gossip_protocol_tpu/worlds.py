@@ -1,0 +1,220 @@
+"""Closed-form adversarial failure worlds, shared by both models.
+
+The course's scenario vocabulary is three worlds — single fail, multi
+fail, 10% uniform drop — plus the churn extension, while the protocol
+family this framework reproduces (SWIM-style membership, accrual
+failure detectors) is evaluated in the literature under partitions,
+correlated failures, message-loss asymmetry, and flapping members.
+This module is the single source of truth for those richer worlds:
+every draw is a pure counter-hash function of ``(seed, tick, node)``
+(utils/hash32.mix32), exactly like the existing churn/drop machinery,
+so
+
+* the dense model (state.make_schedule_host) precomputes them into
+  Schedule arrays,
+* the overlay model (models/overlay.OverlaySchedule) evaluates them
+  in traced code with zero lookup tables,
+* the numpy oracle (testing/overlay_oracle.py) replays them
+  bit-exactly,
+* fleet lanes stay bit-replayable: seeds move *which* nodes are hit,
+  never the windows — the windows are seed-independent config
+  functions, which is what lets them ride the segment planner
+  (models/segments.phase_windows) and the service bucket keys
+  unchanged.
+
+The five worlds (config knobs on :class:`~.config.SimConfig`):
+
+* **partition** (``partition_groups >= 2``) — every node is hashed
+  into one of G groups; during ``(partition_open_tick,
+  partition_close_tick]`` cross-group sends are blocked (gossip,
+  JOINREQ, JOINREP alike — the gate rides the drop plane, applied at
+  send time like a drop decision).  Healing is the window closing.
+* **asymmetric per-link drop** (``asym_drop``) — the single uniform
+  ``msg_drop_prob`` becomes a direction- and pair-dependent matrix:
+  link (i -> j) drops with probability ``U(seed, i*N+j) * 2p`` (mean
+  ``p``), so some links are near-clean and some lose ~2p of traffic.
+  Active during the ordinary drop window.
+* **correlated failure wave** (``wave_size > 0``) — a seeded
+  epicenter plus a radius-per-tick ramp: the ``wave_size`` nodes in
+  the contiguous ring block starting at the epicenter fail at
+  ``wave_start + offset // wave_speed`` — k failures within a short
+  window instead of independent draws.  Replaces the scripted
+  single/multi failure (like churn does); composes with
+  ``rejoin_after``.
+* **zombie / stale-table peers** (``zombie``) — a window-failed peer
+  keeps gossiping its frozen table and frozen heartbeat after its
+  fail tick.  Receivers treat the frozen heartbeat as what it is —
+  an old observation (its liveness claim is timestamped at the fail
+  tick, not the send tick) — so detection still completes, and the
+  stale table must not resurrect removed members (the false-positive
+  stress the world exists for).
+* **flapping members** (``flap_rate > 0``) — a hashed subset of nodes
+  fail and rejoin periodically inside ``[flap_open, flap_close]``
+  with a closed-form duty cycle: each flapper's cycle anchor is
+  ``flap_open + H(seed, i) % flap_period``, it is down for
+  ``flap_down`` ticks of every period (only cycles that complete
+  before ``flap_close`` run), and every up-edge re-enters through the
+  normal JOINREQ path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .config import INTRODUCER, SimConfig
+from .utils.hash32 import mix32, threshold32
+
+#: counter-hash salts for the world streams (1-8 are taken by the
+#: overlay's mask/drop/churn/slot/degree streams, models/overlay.py)
+SALT_LINK = 9         # per-link drop threshold (asym_drop)
+SALT_PART = 10        # partition group assignment
+SALT_FLAP = 11        # flapping-member selection
+SALT_FLAP_PHASE = 12  # per-flapper cycle anchor
+SALT_WAVE = 13        # wave epicenter
+
+_U = np.uint32
+
+
+# ---- resolved windows (seed-independent config functions) ----------
+
+def wave_start(cfg: SimConfig) -> int:
+    """Absolute tick the wave's epicenter fails (-1 knob = fail_tick)."""
+    return cfg.fail_tick if cfg.wave_tick < 0 else cfg.wave_tick
+
+
+def wave_last_fail(cfg: SimConfig) -> int:
+    """Last tick any wave victim fails (the radius ramp's end)."""
+    return wave_start(cfg) + (cfg.wave_size - 1) // max(cfg.wave_speed, 1)
+
+
+def flap_window(cfg: SimConfig) -> tuple[int, int]:
+    """Resolved ``[flap_open, flap_close]`` (the -1 knobs default to
+    the churn machinery's quarter points)."""
+    lo = cfg.total_ticks // 4 if cfg.flap_open_tick < 0 \
+        else cfg.flap_open_tick
+    hi = (3 * cfg.total_ticks) // 4 if cfg.flap_close_tick < 0 \
+        else cfg.flap_close_tick
+    return lo, hi
+
+
+def partition_window(cfg: SimConfig) -> tuple[int, int]:
+    """Droppable cross-group sends: ``open < t <= close`` (the same
+    half-open convention as the drop window)."""
+    return cfg.partition_open_tick, cfg.partition_close_tick
+
+
+# ---- host-side draws (numpy; the dense Schedule arrays) ------------
+
+def wave_center(cfg: SimConfig) -> int:
+    """Seeded epicenter of the correlated failure wave."""
+    return int(mix32(_U(cfg.seed & 0xFFFFFFFF), _U(0), _U(SALT_WAVE))) \
+        % cfg.n
+
+
+def wave_fail_ticks(cfg: SimConfig) -> np.ndarray:
+    """i32[N] wave fail tick per node (NEVER outside the victim
+    block).  Victims are the ``wave_size`` ids in the contiguous ring
+    block from the epicenter (introducer excluded — its failure would
+    suspend the join path, which is the churn rule too); the node at
+    ring offset ``d`` fails at ``wave_start + d // wave_speed``."""
+    from .state import NEVER
+    n = cfg.n
+    off = (np.arange(n) - wave_center(cfg)) % n
+    victim = (off < cfg.wave_size) & (np.arange(n) != INTRODUCER)
+    t0 = wave_start(cfg)
+    return np.where(victim, t0 + off // max(cfg.wave_speed, 1),
+                    NEVER).astype(np.int32)
+
+
+def partition_groups_host(cfg: SimConfig) -> np.ndarray:
+    """i32[N] hashed group id per node (zeros when the world is off)."""
+    n = cfg.n
+    if cfg.partition_groups < 2:
+        return np.zeros(n, np.int32)
+    g = mix32(_U(cfg.seed & 0xFFFFFFFF),
+              np.arange(n, dtype=np.uint32), _U(SALT_PART))
+    return (g % _U(cfg.partition_groups)).astype(np.int32)
+
+
+def link_prob_host(cfg: SimConfig) -> np.ndarray:
+    """f32[N, N] per-link drop probability (sender-major), mean
+    ``msg_drop_prob``; a f32[0, 0] placeholder when asym_drop is off
+    (the tick branches statically, so the field is never read)."""
+    if not cfg.asym_drop:
+        return np.zeros((0, 0), np.float32)
+    n = cfg.n
+    i = np.arange(n, dtype=np.uint32)
+    # i*N+j wraps in uint32 at very large N — deliberate: it is a hash
+    # input, and both backends wrap identically
+    h = mix32(_U(cfg.seed & 0xFFFFFFFF),
+              i[:, None] * _U(n) + i[None, :], _U(SALT_LINK))
+    return (h.astype(np.float64) / 4294967296.0
+            * 2.0 * cfg.msg_drop_prob).astype(np.float32)
+
+
+def flap_threshold(cfg: SimConfig) -> int:
+    return threshold32(cfg.flap_rate) if cfg.flap_rate > 0 else 0
+
+
+def flap_mask_host(cfg: SimConfig) -> np.ndarray:
+    """bool[N]: which nodes flap (introducer never — its down phases
+    would drop every rejoin's JOINREQ)."""
+    n = cfg.n
+    if cfg.flap_rate <= 0:
+        return np.zeros(n, bool)
+    sel = mix32(_U(cfg.seed & 0xFFFFFFFF),
+                np.arange(n, dtype=np.uint32), _U(SALT_FLAP)) \
+        < _U(flap_threshold(cfg))
+    sel = np.asarray(sel, bool).copy()
+    sel[INTRODUCER] = False
+    return sel
+
+
+def flap_anchor_host(cfg: SimConfig) -> np.ndarray:
+    """i32[N] absolute cycle anchor per node: ``flap_open +
+    H(seed, i) % flap_period`` (meaningless where flap_mask is off)."""
+    n = cfg.n
+    lo, _ = flap_window(cfg)
+    ph = mix32(_U(cfg.seed & 0xFFFFFFFF),
+               np.arange(n, dtype=np.uint32), _U(SALT_FLAP_PHASE)) \
+        % _U(max(cfg.flap_period, 1))
+    return (lo + ph.astype(np.int64)).astype(np.int32)
+
+
+def make_flap_state(cfg: SimConfig):
+    """``(i, t) -> (failed, rejoining)`` closure over precomputed
+    flap_mask/flap_anchor arrays — the scalar-oracle twin of
+    ``Schedule``/``OverlaySchedule`` flap math.  A flapper is down for
+    positions [1, flap_down] of each cycle and rejoins at position
+    flap_down, cycles running only when they complete before
+    flap_close.  Hashes are drawn once here; per-(node, tick) queries
+    are O(1), which the message-level oracle relies on (it queries
+    every destination every tick)."""
+    if cfg.flap_rate <= 0:
+        return lambda i, t: (False, False)
+    mask = flap_mask_host(cfg)
+    anchors = flap_anchor_host(cfg)
+    _, hi = flap_window(cfg)
+    per = max(cfg.flap_period, 1)
+    down = cfg.flap_down
+
+    def state(i: int, t: int) -> tuple[bool, bool]:
+        if not bool(mask[i]):
+            return False, False
+        anchor = int(anchors[i])
+        pos = t - anchor
+        if pos < 1:
+            return False, False
+        c = pos // per
+        off = pos - c * per
+        if anchor + c * per + down > hi:
+            return False, False
+        return (1 <= off <= down), off == down
+
+    return state
+
+
+def flap_state_host(cfg: SimConfig, i: int, t: int) -> tuple[bool, bool]:
+    """One-shot ``make_flap_state`` query (re-draws the hash arrays;
+    use the closure for per-tick loops)."""
+    return make_flap_state(cfg)(i, t)

@@ -5,10 +5,11 @@ opening five+ files and hunting for the comparable keys.  This script
 folds them into one table — headline node-ticks/s, fleet batching
 speedup, serving replay speedup (best recorded: mixed / mesh / the
 204-request curve's top row), p95 latency, device-wait fraction, the
-chaos gate, and the open-loop load columns (max achieved rps +
-measured saturation point, PR 7+; older jsons without the entry
-render "-") — so a regression (or a claimed win) is visible at a
-glance, PR over PR.
+chaos gate, the open-loop load columns (max achieved rps + measured
+saturation point, PR 7+), and the scenario-frontier columns (variants
+graded + oracle pass rate, PR 9+; older jsons without an entry render
+"-") — so a regression (or a claimed win) is visible at a glance,
+PR over PR.
 
     PYTHONPATH=. python scripts/bench_trajectory.py          # table
     PYTHONPATH=. python scripts/bench_trajectory.py --json   # rows
@@ -94,6 +95,9 @@ def load_rows():
         # every field defaults to None and renders as "-"
         load = sec.get("service_load_openloop") or {}
         load_miss = _get(load, "slo_ab", "miss_rate_on")
+        # scenario-frontier entry (PR 9+): the adversarial-world sweep
+        # graded as one service run; absent in earlier jsons -> "-"
+        scen = sec.get("scenario_sweep") or {}
         rows.append({
             "pr": pr,
             "backend": d.get("backend"),
@@ -115,6 +119,9 @@ def load_rows():
             "load_miss_rate_slo_on": load_miss,
             "load_deterministic": _get(load, "replay_check",
                                        "deterministic"),
+            "scenario_variants": scen.get("variants"),
+            "scenario_pass_rate": scen.get("oracle_pass_rate"),
+            "scenario_replayed": scen.get("replayed_digest_for_digest"),
         })
     return rows
 
@@ -146,7 +153,9 @@ def main(argv) -> int:
             ("elastic", "elastic_completion", "{:.0%}"),
             ("legs", "elastic_mean_legs", "{:.1f}"),
             ("load rps", "load_max_achieved_rps", "{:.1f}"),
-            ("sat rps", "load_saturation_rps", "{:.1f}")]
+            ("sat rps", "load_saturation_rps", "{:.1f}"),
+            ("scen", "scenario_variants", "{}"),
+            ("scen ok", "scenario_pass_rate", "{:.0%}")]
     table = [[_fmt(r.get(key), spec) for _, key, spec in cols]
              for r in rows]
     widths = [max(len(h), *(len(t[i]) for t in table))

@@ -13,6 +13,8 @@ Modes:
     python scripts/service_smoke.py pipeline [34]     # pipelined vs sync per D
     python scripts/service_smoke.py load [24]         # open-loop 3-seed sweep
     python scripts/service_smoke.py elastic [34] [48] # loss+return legs sweep
+    python scripts/service_smoke.py scenarios [20]    # adversarial-world sweep
+    python scripts/service_smoke.py scenario --family F --seed S  # 1 repro
 
 ``elastic`` (PR 8) exercises the elasticity ladder end to end
 (docs/SERVING.md "Elastic capacity"): for each of three fault seeds
@@ -26,6 +28,20 @@ restarted from tick 0 (every interrupted lane resumes from its last
 segment-boundary checkpoint), per-request bit-parity against solo
 runs, and the first seed re-run digest-for-digest (fault schedule +
 per-request status/retries/legs).
+
+``scenarios`` (PR 9) is the scenario-frontier acceptance run
+(docs/SCENARIOS.md): the full adversarial-world catalog
+(models/scenarios.py — partitions that heal, asymmetric per-link
+loss, correlated failure waves, zombie peers, flapping members; both
+models) x ``seeds_per_family`` seeds, graded as ONE FleetService run
+with every variant's closed-form oracle verdict recorded.  Gates
+(enforced inside scenarios.sweep + here): 100% of variants terminal,
+every oracle green, and the whole sweep re-run digest-for-digest
+(verdicts AND final-state outcome digests) — identical seeds must
+reproduce identical worlds.  A failing variant prints its exact
+single-variant repro, which is the ``scenario`` mode:
+``scenario --family dense_wave --seed 1007`` re-runs one variant solo
+(no service) and prints its verdict + lane digest.
 
 ``load`` (PR 7) exercises the open-loop traffic plane
 (service/traffic.py + service/slo.py + service/loadbench.py): for
@@ -308,6 +324,55 @@ def main(argv) -> int:
               f"seed replay {'OK' if reproduced else 'FAIL'} "
               f"(schedule {m2['schedule_digest']}, "
               f"outcomes {m2['outcome_digest']})", flush=True)
+        return 0 if ok else 1
+    elif mode == "scenario":
+        from gossip_protocol_tpu.models import scenarios
+        opts = dict(zip(argv[1::2], argv[2::2]))
+        fam = opts.get("--family")
+        if fam not in scenarios.CATALOG:
+            print(f"unknown family {fam!r}; one of "
+                  f"{sorted(scenarios.CATALOG)}")
+            return 2
+        seed = int(opts.get("--seed", 1000))
+        claim = scenarios.CATALOG[fam].claim
+        print(f"{fam}/{seed}: {claim}", flush=True)
+        violations, digest = scenarios.run_solo(fam, seed)
+        print(f"lane digest {digest}")
+        if violations:
+            for v in violations:
+                print(f"  VIOLATION: {v}")
+            return 1
+        print("oracle PASS")
+        return 0
+    elif mode == "scenarios":
+        from gossip_protocol_tpu.models import scenarios
+        seeds = int(argv[1]) if len(argv) > 1 else 20
+        n_fam = len(scenarios.CATALOG)
+        print(f"scenario sweep: {n_fam} families x {seeds} seeds = "
+              f"{n_fam * seeds} variants, one FleetService run",
+              flush=True)
+        r = scenarios.sweep(seeds_per_family=seeds)
+        for name in sorted(r["per_family"]):
+            pf = r["per_family"][name]
+            print(f"  {name:26s} pass {pf['pass']:3d} / "
+                  f"fail {pf['fail']:3d}   {scenarios.CATALOG[name].claim}",
+                  flush=True)
+        print(f"{r['variants']} variants in {r['wall_s']:.1f}s, "
+              f"{r['dispatches']} dispatches over {r['buckets']} buckets, "
+              f"occupancy {r['mean_occupancy']:.2f}", flush=True)
+        r2 = scenarios.sweep(seeds_per_family=seeds)
+        reproduced = (r2["verdict_digest"] == r["verdict_digest"]
+                      and r2["outcome_digest"] == r["outcome_digest"])
+        ok = (r["pass_rate"] == 1.0 and r["terminal_rate"] == 1.0
+              and reproduced)
+        print(f"acceptance: {r['variants']} variants "
+              f"{'OK' if r['variants'] >= 200 else 'FAIL'} (>=200), "
+              f"100% terminal OK (enforced), oracle pass rate "
+              f"{r['pass_rate']:.4f} "
+              f"{'OK' if r['pass_rate'] == 1.0 else 'FAIL'}, "
+              f"seed replay {'OK' if reproduced else 'FAIL'} "
+              f"(verdicts {r['verdict_digest']}, "
+              f"outcomes {r['outcome_digest']})", flush=True)
         return 0 if ok else 1
     elif mode == "load":
         from gossip_protocol_tpu.service.loadbench import (

@@ -38,10 +38,17 @@ def _cfg(scenario, n=64):
         return SimConfig(max_nnb=n, single_failure=True, drop_msg=False,
                          seed=9, total_ticks=120, fail_tick=30,
                          rejoin_after=25)
+    if scenario == "wave":
+        # the one adversarial world inside the mega envelope: pure
+        # schedule data (worlds.wave_fail_ticks rewrites fail_tick)
+        return SimConfig(max_nnb=n, single_failure=False, drop_msg=False,
+                         seed=11, total_ticks=120, wave_size=6,
+                         wave_tick=40, wave_speed=2)
     raise ValueError(scenario)
 
 
-@pytest.mark.parametrize("scenario", ["single", "multi", "drop", "churn"])
+@pytest.mark.parametrize("scenario", ["single", "multi", "drop", "churn",
+                                      "wave"])
 def test_dense_megakernel_bitwise_equals_xla(scenario):
     cfg = _cfg(scenario)
     sched = make_schedule(cfg)
@@ -73,7 +80,8 @@ def test_dense_megakernel_odd_length_chunks():
     assert np.array_equal(np.asarray(ex.sent), np.asarray(em.sent))
 
 
-@pytest.mark.parametrize("scenario", ["single", "multi", "drop", "churn"])
+@pytest.mark.parametrize("scenario", ["single", "multi", "drop", "churn",
+                                      "wave"])
 def test_dense_megakernel_events_equal_xla(scenario):
     """Trace mode: the kernel-emitted added/removed masks match the
     per-tick XLA path's TickEvents exactly (the graded dbg.log path
@@ -95,6 +103,11 @@ def test_dense_megakernel_events_equal_xla(scenario):
 
 def test_dense_mega_envelope():
     assert dense_mega_supported(_cfg("single", 64))
+    # wave-only configs keep the fast path (schedule data); every
+    # other world falls back to the XLA per-tick path
+    assert dense_mega_supported(_cfg("wave", 64))
+    assert not dense_mega_supported(_cfg("single", 64).replace(zombie=True))
+    assert not dense_mega_supported(_cfg("wave", 64).replace(zombie=True))
     assert dense_mega_supported(_cfg("single", 512))
     big = SimConfig(max_nnb=1024, single_failure=True, drop_msg=False,
                     total_ticks=50)

@@ -460,3 +460,92 @@ def test_elastic_acceptance():
                        fault_seed=20260804)
     assert m["requests"] == 204 and m["completed"] == 204
     assert m["restarted_from_zero"] == 0
+
+
+# ---- wall-clock-triggered checkpoints (PR 9 satellite) ---------------
+class _StepClock:
+    """A fake service clock that advances a fixed step per reading:
+    every clock DELTA the scheduler measures is a pure function of how
+    many times it looked, so the seconds->ticks budget conversion is
+    bit-deterministic run to run."""
+
+    def __init__(self, step=0.05):
+        self.t = 0.0
+        self.step = float(step)
+
+    def __call__(self):
+        self.t += self.step
+        return self.t
+
+    def sleep(self, dt):
+        self.t += max(float(dt), 0.0)
+
+
+def test_checkpoint_budget_knobs_validated():
+    with pytest.raises(ValueError, match="two spellings"):
+        FleetService(checkpoint_every=16, checkpoint_every_s=1.0)
+    with pytest.raises(ValueError, match="> 0"):
+        FleetService(checkpoint_every_s=0.0)
+
+
+def test_checkpoint_every_s_converts_budget_and_stays_deterministic():
+    """FleetService(checkpoint_every_s=): the seconds budget becomes a
+    tick budget via the per-bucket wall-per-tick EWMA (seeded by warm,
+    measured from CLOCK deltas) and cut_for_budget — under a fake
+    stepping clock the whole leg structure is deterministic, results
+    stay bit-identical to solo runs, and nothing restarts from 0."""
+    ov = _overlay_churn_drop()
+
+    def run_once():
+        from gossip_protocol_tpu.core.tick import run_build_count
+        clk = _StepClock(0.05)
+        svc = FleetService(max_batch=2, checkpoint_every_s=1e-3,
+                          clock=clk, sleep=clk.sleep)
+        svc.warm(ov, "trace")
+        b0 = run_build_count()
+        hs = [svc.submit(ov, seed=s) for s in (1, 2)]
+        svc.drain()
+        return hs, svc.stats(), run_build_count() - b0
+
+    hs, st, live_builds = run_once()
+    # warm() pre-built the leg chain the seconds budget resolves to,
+    # so the live dispatches compile nothing in-band
+    assert live_builds == 0
+    assert st["checkpoint_every_s"] == 1e-3
+    assert st["checkpoint_every"] is None
+    # the tiny seconds budget forces interior cuts: real legs ran
+    assert st["elastic"]["checkpoints_taken"] >= 1
+    assert st["elastic"]["resume_dispatches"] >= 1
+    assert st["elastic"]["restarted_lanes"] == 0
+    assert all(h.status == "completed" for h in hs)
+    assert all(h.metrics.legs >= 2 for h in hs)
+    for s, h in zip((1, 2), hs):
+        _assert_overlay_equal(solo_execute(ov.replace(seed=s), "trace"),
+                              h.result(), tag=f"seed{s}")
+    # budget determinism: an identical fake-clock run reproduces the
+    # exact leg structure, dispatch for dispatch
+    hs2, st2, _ = run_once()
+    for k in ("checkpoints_taken", "resume_dispatches",
+              "restarted_lanes"):
+        assert st2["elastic"][k] == st["elastic"][k], k
+    assert st2["dispatches"] == st["dispatches"]
+    assert [h.metrics.legs for h in hs2] == [h.metrics.legs for h in hs]
+
+
+def test_checkpoint_every_s_unwarmed_runs_monolithic():
+    """No wall-per-tick estimate yet (no warm, frozen virtual clock):
+    the first dispatch must run monolithic rather than guess a
+    budget — and still complete with solo parity."""
+    from gossip_protocol_tpu.service import VirtualClock
+    ov = _overlay_churn_drop()
+    vc = VirtualClock()
+    svc = FleetService(max_batch=2, checkpoint_every_s=1e-3, clock=vc,
+                       sleep=vc.sleep)
+    hs = [svc.submit(ov, seed=s) for s in (1, 2)]
+    svc.drain()
+    assert all(h.status == "completed" for h in hs)
+    assert all(h.metrics.legs == 1 for h in hs)
+    assert svc.stats()["elastic"]["checkpoints_taken"] == 0
+    for s, h in zip((1, 2), hs):
+        _assert_overlay_equal(solo_execute(ov.replace(seed=s), "trace"),
+                              h.result(), tag=f"seed{s}")

@@ -43,6 +43,25 @@ def _overlay_cfg(**kw):
     ("powerlaw", dict(max_nnb=64, seed=6, topology="powerlaw",
                       total_ticks=100, drop_msg=True, msg_drop_prob=0.1,
                       drop_open_tick=20, drop_close_tick=80)),
+    # the adversarial failure worlds (worlds.py, PR 9) — every draw is
+    # the same counter-hash discipline, so the oracle replays them
+    # bit-exactly too
+    ("partition", dict(partition_groups=2, partition_open_tick=20,
+                       partition_close_tick=55, seed=4)),
+    ("asym_drop", dict(drop_msg=True, msg_drop_prob=0.15, asym_drop=True,
+                       drop_open_tick=10, drop_close_tick=60, seed=2)),
+    ("wave", dict(single_failure=False, wave_size=8, wave_tick=35,
+                  wave_speed=2, seed=7)),
+    ("zombie", dict(zombie=True, seed=8)),
+    ("zombie_rejoin", dict(zombie=True, rejoin_after=25,
+                           total_ticks=100, seed=9)),
+    ("flapping", dict(flap_rate=0.4, flap_period=24, flap_down=6,
+                      fail_tick=10_000, total_ticks=100, seed=10)),
+    ("part_asym_flap", dict(partition_groups=3, partition_open_tick=25,
+                            partition_close_tick=50, drop_msg=True,
+                            msg_drop_prob=0.1, asym_drop=True,
+                            flap_rate=0.25, flap_period=20, flap_down=5,
+                            total_ticks=100, seed=11)),
 ])
 def test_overlay_oracle_parity(name, kw):
     """Bit-exact state trajectory vs the scalar oracle."""
@@ -239,9 +258,17 @@ def test_overlay_memory_is_bounded():
 
 
 def test_overlay_requires_power_of_two():
-    cfg = _overlay_cfg(max_nnb=48)
-    with pytest.raises(AssertionError, match="power of two"):
-        make_overlay_tick(cfg)
+    """The power-of-two-n restriction fires EARLY, at config
+    construction, with the reason and the nearest valid n — a bad n
+    used to fail deep in the XOR exchange (PR 9 satellite)."""
+    with pytest.raises(ValueError, match="power of two") as ei:
+        _overlay_cfg(max_nnb=48)
+    # 48 sits exactly between 32 and 64; the tie goes low
+    assert "nearest valid n is 32" in str(ei.value)
+    with pytest.raises(ValueError, match="nearest valid n is 4"):
+        _overlay_cfg(max_nnb=3)
+    # the dense model keeps arbitrary n
+    SimConfig(max_nnb=48)
 
 
 def test_overlay_checkpoint_resume_bit_identical(tmp_path):

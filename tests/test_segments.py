@@ -166,3 +166,103 @@ def test_planner_is_seed_independent():
                                          GRID_TICKS))
              for s in (0, 1, 2, 99)}
     assert len(plans) == 1
+
+
+# ---- adversarial-world windows (worlds.py, PR 9) ----------------------
+
+def _world_cfg(**kw):
+    base = dict(max_nnb=64, model="overlay", single_failure=True,
+                drop_msg=False, seed=5, total_ticks=160, fail_tick=60,
+                step_rate=0.25)
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def test_partition_window_rides_the_drop_plane():
+    """The partition window unions into drop_lo/drop_hi (it blocks
+    sends exactly like the drop window), drop world on or off."""
+    cfg = _world_cfg(partition_groups=2, partition_open_tick=30,
+                     partition_close_tick=80)
+    win = phase_windows(cfg)
+    assert win.drop_lo == 31 and win.drop_hi == 80
+    assert not flags_at(win, 30).drop_live
+    assert flags_at(win, 31).drop_live and flags_at(win, 80).drop_live
+    assert not flags_at(win, 81).drop_live
+    both = _world_cfg(partition_groups=2, partition_open_tick=30,
+                      partition_close_tick=80, drop_msg=True,
+                      msg_drop_prob=0.1, drop_open_tick=50,
+                      drop_close_tick=100)
+    bwin = phase_windows(both)
+    assert bwin.drop_lo == 31 and bwin.drop_hi == 100
+
+
+def test_wave_window_replaces_the_scripted_fail_tick():
+    """The wave's radius ramp sets the churn window: [wave_start,
+    wave_last_fail] (+ rejoin), never the seed-moved victim set."""
+    cfg = _world_cfg(single_failure=False, wave_size=9, wave_tick=70,
+                     wave_speed=2)
+    win = phase_windows(cfg)
+    assert win.fail_lo == 70
+    # conservative by one tick at the fail boundary, like the
+    # scripted window (test_planner_windows_and_flags)
+    assert not flags_at(win, 69).churn_live
+    assert flags_at(win, 71).churn_live
+    assert flags_at(win, 10_000).churn_live      # no rejoin: permanent
+    rj = phase_windows(cfg.replace(rejoin_after=20))
+    # last victim fails at 70 + 8//2 = 74; rejoined by 94
+    assert rj.rejoin_hi == 94
+    assert not flags_at(rj, 95).churn_live
+    assert rj.join_dead_from == 94 + 3           # rejoins re-JOINREQ
+
+
+def test_flap_window_widens_churn_and_join():
+    """Flapping members keep churn_live AND join_live on through the
+    flap window (every up-edge re-enters via JOINREQ)."""
+    cfg = _world_cfg(flap_rate=0.3, flap_period=24, flap_down=6,
+                     flap_open_tick=50, flap_close_tick=120,
+                     fail_tick=10_000)
+    win = phase_windows(cfg)
+    assert win.fail_lo == 51 and win.rejoin_hi >= 120
+    assert flags_at(win, 100).churn_live and flags_at(win, 100).join_live
+    assert win.join_dead_from == 123
+    assert not flags_at(win, 123).join_live
+    # the -1 knobs default to the churn machinery's quarter points
+    dflt = phase_windows(_world_cfg(flap_rate=0.3, fail_tick=10_000))
+    assert dflt.fail_lo == 160 // 4 + 1
+    assert dflt.rejoin_hi >= (3 * 160) // 4
+
+
+def test_world_plan_signatures_are_distinct_and_seedless():
+    """A world-knob edit always re-buckets; a seed edit never does —
+    and the zombie/asym worlds (no window of their own) still change
+    plan identity."""
+    from gossip_protocol_tpu.models.segments import plan_signature
+    base = _world_cfg()
+    zomb = _world_cfg(zombie=True)
+    asym = _world_cfg(drop_msg=True, msg_drop_prob=0.1, asym_drop=True)
+    uni = _world_cfg(drop_msg=True, msg_drop_prob=0.1)
+    part = _world_cfg(partition_groups=2, partition_open_tick=30,
+                      partition_close_tick=80)
+    part2 = _world_cfg(partition_groups=3, partition_open_tick=30,
+                       partition_close_tick=80)
+    sigs = [plan_signature(c) for c in (base, zomb, asym, uni, part,
+                                        part2)]
+    assert len(set(sigs)) == len(sigs)
+    assert plan_signature(part) == plan_signature(part.replace(seed=9))
+
+
+def test_world_checkpoint_cuts_are_seed_shared():
+    """checkpoint_ticks for a partition scenario cuts at the window
+    boundaries and is identical across seeds (lanes of a fleet agree
+    on the legal snapshot points by construction)."""
+    from gossip_protocol_tpu.models.segments import checkpoint_ticks
+    cfg = _world_cfg(partition_groups=2, partition_open_tick=48,
+                     partition_close_tick=96, fail_tick=10_000)
+    cuts = checkpoint_ticks(cfg)
+    assert cuts, "partition plan offered no interior cuts"
+    assert cuts == checkpoint_ticks(cfg.replace(seed=123))
+    # the window opening lands on a launch-aligned cut (48 is a
+    # multiple of the 16-tick quantum); the close tick 96 is the last
+    # LIVE tick, so its segment runs through the covering launch and
+    # the post-partition steady segment starts at 112
+    assert 48 in cuts and 112 in cuts

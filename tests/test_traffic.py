@@ -385,3 +385,94 @@ def test_loadbench_replay_check_deterministic():
                       slo=_slo(deadline=6.0, wall=0.25))
     assert rc["deterministic"], rc
     assert rc["runs"] == 2 and len(rc["arrival_digest"]) == 16
+
+
+# ---- weighted fair queuing between SLO classes (PR 9 satellite) ------
+def _wfq_slo(**weights):
+    from gossip_protocol_tpu.service.slo import default_slo
+    return default_slo(assumed_dispatch_wall_s=0.3).with_weights(
+        weights or None)
+
+
+def test_wfq_weights_validated():
+    """Bad weight knobs fail at policy construction, typed."""
+    with pytest.raises(ValueError, match="unknown classes"):
+        _wfq_slo(nosuch=2.0)
+    with pytest.raises(ValueError, match="> 0"):
+        _wfq_slo(interactive=0.0)
+    slo = _wfq_slo(interactive=8.0)
+    assert slo.weight_of("interactive") == 8.0
+    # classes absent from the mapping inherit their ClassPolicy weight
+    assert slo.weight_of("standard") == slo.classes["standard"].weight
+    # with_weights(None) restores tightest-deadline-first ordering
+    assert slo.with_weights(None).weights is None
+
+
+def test_wfq_orders_buckets_by_normalized_deficit():
+    """With ``slo.weights`` set, pump order is least-served-per-weight
+    first: after a dispatch is charged to the standard class, the
+    heavy interactive bucket jumps ahead of the earlier-created
+    standard one; without weights the earlier bucket keeps its
+    tightest-deadline/FIFO place."""
+    vc = VirtualClock()
+    slo = _wfq_slo(interactive=8.0, standard=1.0)
+    svc = FleetService(max_batch=4, max_wait_s=100.0, clock=vc,
+                       sleep=vc.sleep, slo=slo, pump_harvest=False)
+    # bucket A (standard) created first, bucket B (interactive) second
+    svc.submit(_dense_churn(), seed=1, priority="standard")
+    svc.submit(_dense_drop(), seed=1, priority="interactive")
+    order0 = svc._pump_order()
+    assert len(order0) == 2
+    # zero service everywhere: deficit ties, creation order breaks it
+    assert svc._dominant_class(svc._queues[order0[0]]) == "standard"
+    # charge the standard class one dispatched lane; the interactive
+    # bucket (deficit 0) must now order first despite its later birth
+    svc._wfq_served["standard"] = 1.0
+    order1 = svc._pump_order()
+    assert svc._dominant_class(svc._queues[order1[0]]) == "interactive"
+    # the normalization: 8 lanes of interactive service / weight 8
+    # equals 1 lane of standard / weight 1 — back to creation order
+    svc._wfq_served["interactive"] = 8.0
+    order2 = svc._pump_order()
+    assert svc._dominant_class(svc._queues[order2[0]]) == "standard"
+    svc.drain()
+
+
+def test_wfq_run_serves_all_and_reports_shares():
+    """An end-to-end WFQ run: every handle terminal, per-class service
+    counters reported, results bit-identical to solo runs."""
+    slo = _wfq_slo(interactive=8.0)
+    svc = FleetService(max_batch=2, slo=slo)
+    hs = [svc.submit(_dense_churn(), seed=s, priority=p)
+          for s in (1, 2) for p in ("interactive", "batch")]
+    svc.drain()
+    assert all(h.status == "completed" for h in hs)
+    st = svc.stats()
+    assert st["wfq_served"]["interactive"] == 2.0
+    assert st["wfq_served"]["batch"] == 2.0
+    for h in hs:
+        ref = Simulation(h.request.cfg).run()
+        got = h.result()
+        assert np.array_equal(ref.added, got.added)
+        assert np.array_equal(ref.removed, got.removed)
+
+
+def test_wfq_virtual_load_replays_digest_for_digest():
+    """WFQ ordering is deterministic on a virtual clock: the same
+    seeded arrival schedule re-driven under weights replays
+    outcome-digest-for-digest (the loadbench wfq A/B's gate)."""
+    tpls = _catalog()
+    sched = make_schedule(tpls, 10,
+                          TrafficPattern(kind="poisson", rate_rps=8.0),
+                          seed=6, class_mix={"interactive": 0.5,
+                                             "standard": 0.5})
+    digs = []
+    for _ in range(2):
+        vc = VirtualClock()
+        svc = FleetService(max_batch=4, clock=vc, sleep=vc.sleep,
+                           slo=_wfq_slo(interactive=8.0),
+                           pump_harvest=False)
+        handles, rec = run_schedule(svc, sched, pace="virtual")
+        assert all(h is not None and h.done for h in handles)
+        digs.append(outcome_digest(sched, handles, rec["sheds"]))
+    assert digs[0] == digs[1]

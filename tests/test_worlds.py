@@ -1,0 +1,405 @@
+"""Adversarial failure worlds (worlds.py, PR 9): dense-model semantics
+vs the message-level reference oracle, and fleet/solo bit-parity for
+every world on both models.
+
+The worlds are pure ``(seed, tick, node)`` counter/PRNG draws layered
+on the existing schedule machinery, so the differential discipline is
+the same as the course worlds': the oracle consumes the byte-identical
+drop decisions (testing/dropsync.py now folds the asym per-link
+thresholds and the partition's deterministic cross-group mask into the
+masks exactly as the tick does), wave schedules ride the fail-tick
+array, and zombie/flap semantics are implemented on both sides.
+Overlay-side bit-exactness lives in
+tests/test_overlay.py::test_overlay_oracle_parity (world cases added
+there); this file owns the dense model and the cross-model fleet
+parity sweep.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from gossip_protocol_tpu import worlds
+from gossip_protocol_tpu.config import INTRODUCER, SimConfig
+from gossip_protocol_tpu.core.fleet import FleetSimulation
+from gossip_protocol_tpu.core.sim import Simulation
+from gossip_protocol_tpu.models.overlay import OverlaySimulation
+from gossip_protocol_tpu.state import NEVER, make_schedule
+from gossip_protocol_tpu.testing.dropsync import make_drop_masks
+from gossip_protocol_tpu.testing.oracle import ReferenceOracle
+
+DENSE_STATE = ("tick", "in_group", "own_hb", "known", "hb", "ts",
+               "gossip", "joinreq", "joinrep")
+OV_STATE = ("tick", "ids", "hb", "ts", "in_group", "own_hb",
+            "send_flags", "joinreq", "joinrep")
+
+
+def _dense(**kw):
+    base = dict(max_nnb=16, single_failure=True, drop_msg=False, seed=2,
+                total_ticks=120, fail_tick=40)
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def _overlay(**kw):
+    base = dict(model="overlay", max_nnb=64, single_failure=True,
+                drop_msg=False, seed=2, total_ticks=96, fail_tick=40,
+                step_rate=8.0 / 64)
+    base.update(kw)
+    return SimConfig(**base)
+
+
+DENSE_WORLDS = {
+    "partition": dict(partition_groups=2, partition_open_tick=30,
+                      partition_close_tick=70),
+    "asym_drop": dict(drop_msg=True, msg_drop_prob=0.12, asym_drop=True,
+                      drop_open_tick=10, drop_close_tick=90),
+    "wave": dict(single_failure=False, wave_size=6, wave_tick=40,
+                 wave_speed=2),
+    "zombie": dict(zombie=True),
+    "flapping": dict(flap_rate=0.4, flap_period=24, flap_down=6,
+                     fail_tick=10_000),
+}
+
+OVERLAY_WORLDS = {
+    "partition": dict(partition_groups=2, partition_open_tick=30,
+                      partition_close_tick=60),
+    "asym_drop": dict(drop_msg=True, msg_drop_prob=0.1, asym_drop=True,
+                      drop_open_tick=10, drop_close_tick=80),
+    "wave": dict(single_failure=False, wave_size=6, wave_tick=40,
+                 wave_speed=2),
+    "zombie": dict(zombie=True),
+    "flapping": dict(flap_rate=0.3, flap_period=24, flap_down=6,
+                     fail_tick=10_000),
+}
+
+
+def _oracle_for(cfg, res):
+    sched = make_schedule(cfg)
+    inject = cfg.drop_msg or cfg.partition_groups >= 2
+    drops = make_drop_masks(cfg, sched) if inject else (None, None, None)
+    flap = worlds.make_flap_state(cfg) if cfg.flap_rate > 0 else None
+    return ReferenceOracle(cfg, res.start_tick, res.fail_tick, *drops,
+                           rejoin_tick=res.rejoin_tick,
+                           flap_state=flap).run()
+
+
+@pytest.mark.parametrize("name", sorted(DENSE_WORLDS))
+def test_dense_world_oracle_parity(name):
+    """Every world's dense tick vs the message-level oracle: event
+    sets, final membership, and (for PRNG-free worlds) exact removal
+    ticks and accounting."""
+    cfg = _dense(**DENSE_WORLDS[name])
+    res = Simulation(cfg).run()
+    o = _oracle_for(cfg, res)
+
+    gv = res.grader_view()
+    assert {(i, j) for (_, i, j) in o.events.added} == gv["joins"], name
+    oracle_removals = {}
+    for (t, i, j) in o.events.removed:
+        oracle_removals.setdefault((i, j), t)
+    # message-lossy worlds (drop window, partition) admit the
+    # documented +/-1 canonical-order heartbeat transient, which can
+    # shift a starved straggler's removal by a tick or two; loss-free
+    # worlds must match exactly, accounting included
+    lossy = cfg.drop_msg or cfg.partition_groups >= 2
+    if lossy:
+        assert set(oracle_removals) == set(gv["removal_ticks"]), name
+        for k2, t_o in oracle_removals.items():
+            assert abs(t_o - gv["removal_ticks"][k2]) <= 2, (name, k2)
+    else:
+        assert oracle_removals == gv["removal_ticks"], name
+        assert np.array_equal(o.sent, res.sent), name
+        assert np.array_equal(o.recv, res.recv), name
+    assert np.array_equal(o.known_matrix(),
+                          np.asarray(res.final_state.known)), name
+
+
+def test_dense_zombie_detected_despite_gossip():
+    """The zombie keeps sending its frozen table after the fail tick
+    (observable traffic), yet is still removed from every live view
+    within the horizon, and its stale table resurrects nobody."""
+    cfg = _dense(zombie=True)
+    res = Simulation(cfg).run()
+    silent = Simulation(cfg.replace(zombie=False)).run()
+    # the zombie world strictly adds traffic after the fail tick
+    assert res.sent[:, cfg.fail_tick + 1:].sum() \
+        > silent.sent[:, cfg.fail_tick + 1:].sum()
+    victim = int(np.flatnonzero(res.fail_tick != NEVER)[0])
+    known = np.asarray(res.final_state.known)
+    live = np.ones(cfg.n, bool)
+    live[victim] = False
+    assert not known[live, victim].any(), "zombie never removed"
+    # no resurrection: after an observer removes the victim, it never
+    # re-adds it (the stale table's entries age out of the fresh gate)
+    rem_t = {}
+    for t, i, j in zip(*np.nonzero(res.removed)):
+        if j == victim:
+            rem_t.setdefault(i, t)
+    assert rem_t, "victim was never removed by anyone"
+    for t, i, j in zip(*np.nonzero(res.added)):
+        if j == victim and i in rem_t:
+            assert t <= rem_t[i], f"observer {i} resurrected the zombie"
+
+
+def test_dense_partition_semantics():
+    """The dense full-view protocol's honest partition behavior, both
+    regimes.  A partition LONGER than t_remove causes mutual
+    cross-group removal, and because the reference protocol gossips
+    only to KNOWN members there is no discovery path back: the split
+    is permanent (same-group liveness untouched).  A partition SHORTER
+    than t_remove ends before any entry crosses the staleness horizon:
+    zero removals, full membership at the end.  (The overlay model
+    re-converges after a long partition because its XOR exchange
+    delivers by index, not by membership — pinned by the partition
+    scenario oracle in models/scenarios.py.)"""
+    # long partition (40 > t_remove=20): permanent split
+    cfg = _dense(partition_groups=2, partition_open_tick=30,
+                 partition_close_tick=70, total_ticks=160,
+                 fail_tick=10_000)
+    g = worlds.partition_groups_host(cfg)
+    res = Simulation(cfg).run()
+    known = np.asarray(res.final_state.known)
+    n = cfg.n
+    same = g[:, None] == g[None, :]
+    off = ~np.eye(n, dtype=bool)
+    assert (known | ~(same & off)).all(), "same-group entries lost"
+    assert not known[~same].any(), \
+        "cross-group entries survived a partition longer than t_remove"
+    cross_rm = [(t, i, j) for t, i, j in zip(*np.nonzero(res.removed))
+                if g[i] != g[j]]
+    assert cross_rm, "no cross-group removals during the partition"
+    same_rm = [(t, i, j) for t, i, j in zip(*np.nonzero(res.removed))
+               if g[i] == g[j]]
+    assert not same_rm, "partition must not disturb same-group liveness"
+    # short partition (12 < t_remove=20): heals with zero removals
+    cfg2 = _dense(partition_groups=2, partition_open_tick=30,
+                  partition_close_tick=42, total_ticks=120,
+                  fail_tick=10_000)
+    res2 = Simulation(cfg2).run()
+    assert not np.asarray(res2.removed).any(), \
+        "sub-horizon partition caused removals"
+    assert (np.asarray(res2.final_state.known) | ~off).all(), \
+        "sub-horizon partition did not heal"
+
+
+def test_overlay_partition_reconverges_after_heal():
+    """The overlay's partition tolerance: the XOR exchange delivers by
+    INDEX, so after the window closes cross-group freshness flows
+    again and every live member is re-covered — even though the
+    partition (60 > t_remove) starved every cross-group entry in
+    between."""
+    cfg = _overlay(partition_groups=2, partition_open_tick=30,
+                   partition_close_tick=90, total_ticks=160,
+                   fail_tick=10_000)
+    res = OverlaySimulation(cfg).run()
+    unc, victim_left = res.final_coverage()
+    assert unc == 0 and victim_left == 0
+
+
+def test_dense_flapping_no_false_removals():
+    """flap_down < t_remove: a flapper's silences are shorter than the
+    staleness horizon, so no observer ever removes anyone."""
+    cfg = _dense(flap_rate=0.4, flap_period=24, flap_down=6,
+                 fail_tick=10_000, total_ticks=140)
+    assert worlds.flap_mask_host(cfg).sum() >= 2, "world never engaged"
+    res = Simulation(cfg).run()
+    assert not np.asarray(res.removed).any(), \
+        "flapping below the horizon caused removals"
+
+
+def test_wave_fail_ticks_shape():
+    """Closed-form wave properties: contiguous ring block from the
+    seeded epicenter, one radius step per wave_speed ticks, introducer
+    exempt, seeds move the epicenter but never the window."""
+    cfg = _dense(single_failure=False, wave_size=6, wave_tick=40,
+                 wave_speed=2)
+    ft = worlds.wave_fail_ticks(cfg)
+    vic = np.flatnonzero(ft != NEVER)
+    assert INTRODUCER not in vic
+    assert len(vic) in (5, 6)     # 6, minus the introducer if covered
+    assert ft[vic].min() == 40
+    assert ft[vic].max() <= 40 + (cfg.wave_size - 1) // cfg.wave_speed
+    assert worlds.wave_last_fail(cfg) == 40 + 5 // 2
+    # seed moves WHICH nodes, never the window
+    c2 = cfg.replace(seed=99)
+    ft2 = worlds.wave_fail_ticks(c2)
+    assert worlds.wave_start(c2) == worlds.wave_start(cfg)
+    assert ft2[ft2 != NEVER].min() == 40
+
+
+@pytest.mark.parametrize("name", sorted(DENSE_WORLDS))
+def test_fleet_dense_world_parity(name):
+    """B=3 dense trace fleet == 3 solo runs, per world, bit-exact."""
+    cfg = _dense(**DENSE_WORLDS[name])
+    seeds = [1, 2, 3]
+    fleet = FleetSimulation(cfg).run(seeds=seeds)
+    sim = Simulation(cfg)
+    for i, s in enumerate(seeds):
+        ref = sim.run(seed=s)
+        lane = fleet.lanes[i]
+        assert np.array_equal(ref.added, lane.added), (name, s)
+        assert np.array_equal(ref.removed, lane.removed), (name, s)
+        assert np.array_equal(ref.sent, lane.sent), (name, s)
+        assert np.array_equal(ref.recv, lane.recv), (name, s)
+        for f in DENSE_STATE:
+            assert np.array_equal(
+                np.asarray(getattr(ref.final_state, f)),
+                np.asarray(getattr(lane.final_state, f))), (name, s, f)
+
+
+@pytest.mark.parametrize("name", sorted(OVERLAY_WORLDS))
+def test_fleet_overlay_world_parity(name):
+    """B=3 overlay fleet == 3 solo runs, per world, bit-exact."""
+    cfg = _overlay(**OVERLAY_WORLDS[name])
+    seeds = [1, 2, 3]
+    fleet = FleetSimulation(cfg).run(seeds=seeds)
+    for i, s in enumerate(seeds):
+        ref = OverlaySimulation(cfg.replace(seed=s),
+                                use_pallas=False).run()
+        lane = fleet.lanes[i]
+        for f in OV_STATE:
+            assert np.array_equal(
+                np.asarray(getattr(ref.final_state, f)),
+                np.asarray(getattr(lane.final_state, f))), (name, s, f)
+
+
+@pytest.mark.parametrize("name", sorted(DENSE_WORLDS))
+def test_mesh_dense_world_parity(name):
+    """D=1 and D=2 virtual-device lane meshes == solo runs, per world,
+    bit-exact (the acceptance-criterion mesh sweep: the world draws are
+    pure lane arithmetic, so sharding the lane axis moves nothing)."""
+    from gossip_protocol_tpu.parallel.fleet_mesh import (
+        MeshFleetSimulation, make_lane_mesh)
+    cfg = _dense(**DENSE_WORLDS[name])
+    seeds = [1, 2]
+    sim = Simulation(cfg)
+    refs = [sim.run(seed=s) for s in seeds]
+    for d in (1, 2):
+        if jax.device_count() < d:
+            pytest.skip(f"needs {d} (virtual) devices")
+        fleet = MeshFleetSimulation(cfg, make_lane_mesh(d)).run(seeds=seeds)
+        for i, ref in enumerate(refs):
+            lane = fleet.lanes[i]
+            assert np.array_equal(ref.added, lane.added), (name, d, i)
+            assert np.array_equal(ref.removed, lane.removed), (name, d, i)
+            for f in DENSE_STATE:
+                assert np.array_equal(
+                    np.asarray(getattr(ref.final_state, f)),
+                    np.asarray(getattr(lane.final_state, f))), (name, d, f)
+
+
+@pytest.mark.parametrize("name", sorted(OVERLAY_WORLDS))
+def test_mesh_overlay_world_parity(name):
+    """Overlay twin of the dense mesh sweep: D=1 and D=2 lane meshes
+    replay every world's solo run bit-for-bit."""
+    from gossip_protocol_tpu.parallel.fleet_mesh import (
+        MeshFleetSimulation, make_lane_mesh)
+    cfg = _overlay(**OVERLAY_WORLDS[name])
+    seeds = [1, 2]
+    refs = [OverlaySimulation(cfg.replace(seed=s), use_pallas=False).run()
+            for s in seeds]
+    for d in (1, 2):
+        if jax.device_count() < d:
+            pytest.skip(f"needs {d} (virtual) devices")
+        fleet = MeshFleetSimulation(cfg, make_lane_mesh(d)).run(seeds=seeds)
+        for i, ref in enumerate(refs):
+            lane = fleet.lanes[i]
+            for f in OV_STATE:
+                assert np.array_equal(
+                    np.asarray(getattr(ref.final_state, f)),
+                    np.asarray(getattr(lane.final_state, f))), (name, d, f)
+
+
+def test_world_configs_validated():
+    """Config-construction guards: bad world knobs fail early and
+    typed."""
+    with pytest.raises(ValueError, match="partition_groups"):
+        _dense(partition_groups=1)
+    with pytest.raises(ValueError, match="empty"):
+        _dense(partition_groups=2, partition_open_tick=50,
+               partition_close_tick=50)
+    with pytest.raises(ValueError, match="drop_msg"):
+        _dense(asym_drop=True)
+    with pytest.raises(ValueError, match="msg_drop_prob"):
+        _dense(asym_drop=True, drop_msg=True, msg_drop_prob=0.6)
+    with pytest.raises(ValueError, match="churn_rate"):
+        _overlay(wave_size=4, single_failure=False, churn_rate=0.2)
+    with pytest.raises(ValueError, match="flap_down"):
+        _dense(flap_rate=0.2, flap_period=8, flap_down=8)
+    # an inverted/too-short flap window would silently never engage
+    with pytest.raises(ValueError, match="flap window"):
+        _dense(flap_rate=0.2, flap_period=8, flap_down=4,
+               flap_open_tick=100, flap_close_tick=50)
+    with pytest.raises(ValueError, match="flap window"):
+        _dense(flap_rate=0.2, flap_period=8, flap_down=4,
+               flap_open_tick=100, flap_close_tick=103)
+    with pytest.raises(ValueError, match="wave_speed"):
+        _dense(wave_size=4, wave_speed=0)
+    # windows entirely past the run end silently never engage
+    with pytest.raises(ValueError, match="never engage"):
+        _dense(partition_groups=2, partition_open_tick=200,
+               partition_close_tick=300, total_ticks=120)
+    with pytest.raises(ValueError, match="never engage"):
+        _dense(wave_size=4, wave_tick=200, total_ticks=120,
+               single_failure=False)
+    # ... but a close past the end is legal: "never heals"
+    _dense(partition_groups=2, partition_open_tick=30,
+           partition_close_tick=10_000, fail_tick=10_000)
+
+
+def test_worlds_key_is_program_identity():
+    """Two configs differing only in a world knob never share a
+    compiled run or a fleet bucket (the zombie/partition/asym/flap
+    branches are static)."""
+    a = _dense(zombie=True)
+    b = a.replace(zombie=False)
+    assert a.worlds_key() != b.worlds_key()
+    from gossip_protocol_tpu.core.fleet import fleet_shape_key
+    assert fleet_shape_key(a) != fleet_shape_key(b)
+    c = _overlay(partition_groups=2, partition_open_tick=10,
+                 partition_close_tick=20)
+    d = c.replace(partition_close_tick=30)
+    assert c.worlds_key() != d.worlds_key()
+    # seeds move which nodes are hit, never the key
+    assert a.worlds_key() == a.replace(seed=7).worlds_key()
+
+
+@pytest.mark.slow
+def test_partition_heal_scenario_through_elastic_service():
+    """Scenario x elasticity composition (PR 9 satellite): the
+    partition-heal scenario served as resumable legs on a D=2 lane
+    mesh with a device loss mid-sequence — the loss costs no work
+    (restarted_lanes == 0), every lane stays bit-identical to its solo
+    run, and the scenario ORACLE still passes on the served results
+    (checkpoint cuts and mesh shrink must not perturb the world)."""
+    if jax.device_count() < 2:
+        pytest.skip("needs 2 (virtual) devices")
+    from gossip_protocol_tpu.models import scenarios
+    from gossip_protocol_tpu.parallel.fleet_mesh import make_lane_mesh
+    from gossip_protocol_tpu.service import (FaultInjector, FleetService,
+                                             RetryPolicy)
+    from gossip_protocol_tpu.service.resilience import solo_execute
+    fam = scenarios.CATALOG["overlay_partition_heal"]
+    seeds = (1000, 1001)
+    svc = FleetService(
+        max_batch=2, mesh=make_lane_mesh(2), checkpoint_every=48,
+        injector=FaultInjector(device_loss_at=2),
+        retry=RetryPolicy(max_retries=3, backoff_base_s=1e-4))
+    svc.warm(fam.build(seeds[0]), "trace")
+    hs = [svc.submit(fam.build(s), mode="trace") for s in seeds]
+    svc.drain()
+    assert [h.status for h in hs] == ["completed", "completed"]
+    st = svc.stats()
+    assert st["failures"]["device_losses"] == 1
+    assert st["elastic"]["restarted_lanes"] == 0
+    assert st["elastic"]["checkpoints_taken"] >= 1
+    for s, h in zip(seeds, hs):
+        cfg = fam.build(s)
+        ref = solo_execute(cfg, "trace")
+        got = h.result()
+        for f in OV_STATE:
+            assert np.array_equal(
+                np.asarray(getattr(ref.final_state, f)),
+                np.asarray(getattr(got.final_state, f))), (s, f)
+        assert scenarios.grade(fam, s, got) == [], s
