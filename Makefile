@@ -31,4 +31,13 @@ clean:
 	rm -f $(NATIVE_OBJS) native/gossip_app.o Application libgossip_native.so \
 	      dbg.log stats.log msgcount.log
 
-.PHONY: all clean
+# Static invariant analysis (PR 10, docs/ANALYSIS.md): the jaxpr audit
+# over the registered hot programs + the AST purity/cache-key passes.
+# Exits nonzero on any finding.  The runtime guard pass is enforced by
+# `python bench.py --check` (compile budget) and tier-1 (transfer
+# guard); `python -m gossip_protocol_tpu.analysis` alone runs all
+# three.
+lint:
+	JAX_PLATFORMS=cpu python -m gossip_protocol_tpu.analysis --pass jaxpr --pass ast
+
+.PHONY: all clean lint

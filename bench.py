@@ -787,7 +787,10 @@ def main():
     }
     print(json.dumps(payload))
     if "--check" in sys.argv:
-        sys.exit(check_regression(payload))
+        rc = check_regression(payload)
+        rc_compiles = check_steady_state_compiles(
+            inject="--inject-recompile" in sys.argv)
+        sys.exit(rc or rc_compiles)
 
 
 #: --check fails the run when the fresh headline falls more than this
@@ -831,6 +834,28 @@ def check_regression(payload: dict) -> int:
           f"-> {verdict} (gate: -{CHECK_REGRESSION_FRAC:.0%})",
           file=sys.stderr)
     return 0 if verdict == "OK" else 1
+
+
+def check_steady_state_compiles(inject: bool = False) -> int:
+    """Compile-count budget gate (``--check``, PR 10): a warmed bench
+    lap must trigger ZERO fresh XLA compiles — a steady-state
+    recompile means a run-cache key regressed or an input shape leaks
+    per call, and on the serving path that is the first-lap cost of
+    PERF §11 paid on EVERY dispatch.  Enforced by
+    analysis/guards.steady_state_compile_gate; ``--inject-recompile``
+    deliberately trips it (the gate's own acceptance fixture — also
+    exercised in-process by tests/test_analysis.py)."""
+    from gossip_protocol_tpu.analysis.guards import \
+        steady_state_compile_gate
+    res = steady_state_compile_gate(inject_recompile=inject)
+    if res["ok"]:
+        print("bench --check compiles: steady-state lap clean "
+              "(0 fresh XLA compiles)", file=sys.stderr)
+        return 0
+    print(f"bench --check compiles: FAIL — {res['compiles']} fresh "
+          f"compile(s) in the steady-state lap: "
+          f"{res.get('compiled', [])}", file=sys.stderr)
+    return 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,152 @@
+"""Static invariant analysis for the tpu-gossip stack.
+
+The engine/serving stack is held together by structural invariants
+that are documented in docs/PERF.md and docs/SERVING.md but — until
+this package — enforced almost nowhere:
+
+* the shared clock and the shared drop plane must ride UNBATCHED, or
+  every clock/window ``lax.cond`` silently degrades to a
+  both-branches ``select_n`` (PERF §8/§10 — measured +43% wall for
+  the re-slot cond, 2.6x the whole dense tick for the drop draw);
+* the mesh tick body must issue ZERO collectives (lane sharding is
+  plain data parallelism, PERF §10);
+* host staging paths must be pure numpy — one eager ``jnp`` scalar on
+  the pack/resolve path can serialize the whole pipelined scheduler
+  behind the in-flight program (PERF §11's silent serializers);
+* every stochastic draw must be a pure ``(seed, idx)`` function, or
+  the chaos/scenario replay digests stop meaning anything;
+* every config field a traced builder reads must be folded into its
+  compile-cache key (or flow through the Schedule arrays as data), or
+  a stale program can serve wrong results.
+
+Each of these was originally found BY HAND after it cost a
+regression.  This package turns the whole bug class into machine
+checks, three passes deep:
+
+* :mod:`.jaxpr_audit` — rules over ``jax.make_jaxpr`` output of the
+  registered hot programs (solo tick, fleet scan, lane-mesh program,
+  grid kernel, checkpoint-leg resume);
+* :mod:`.purity_lint` — repo-specific AST rules over the package
+  source (wall-clock/unseeded-RNG bans in pure paths, numpy-only
+  staging, no in-place writes on host views) plus the cache-key
+  completeness scan (:mod:`.cache_keys`);
+* :mod:`.guards` — runtime context managers (``jax.transfer_guard``
+  wrapping, compile-count budgets) wired into ``bench.py --check``
+  and the tier-1 tests.
+
+Run everything: ``python -m gossip_protocol_tpu.analysis`` (exits
+nonzero on any finding; see ``--help`` for running a single pass or
+rule).  The rule catalog with the motivating regression behind each
+rule lives in docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class Finding:
+    """One rule violation, with enough provenance to act on it."""
+
+    rule: str     # rule name from the catalog below
+    where: str    # program name or file:line
+    detail: str   # what is wrong, in one sentence
+    path: str = ""  # eqn path inside a jaxpr / function name in a file
+
+    def __str__(self) -> str:
+        loc = f"{self.where}" + (f" [{self.path}]" if self.path else "")
+        return f"{self.rule}: {loc}\n    {self.detail}"
+
+
+@dataclass
+class RuleInfo:
+    """Catalog entry: what a rule protects and where it came from."""
+
+    name: str
+    pass_name: str   # "jaxpr" | "ast" | "guard"
+    protects: str
+    origin: str      # the regression / PR that motivated it
+
+
+#: The rule catalog.  docs/ANALYSIS.md is the prose version; the CLI
+#: prints this table with --list.
+RULES: tuple[RuleInfo, ...] = (
+    RuleInfo("cond-stays-cond", "jaxpr",
+             "shared clock / shared drop plane keep window lax.conds "
+             "real conds (no both-branches select_n)",
+             "PR 2 (+43% re-slot wall), PR 3 (2.6x batched drop draw), "
+             "PR 4 (mesh jaxpr pin, PERF §8/§10)"),
+    RuleInfo("zero-collectives-per-tick", "jaxpr",
+             "the lane-mesh tick body issues no psum/all_gather/"
+             "ppermute — lane sharding stays zero-collective",
+             "PR 4 (PERF §10: lanes are plain data parallelism)"),
+    RuleInfo("donation-taken", "jaxpr",
+             "donated scan carries are actually marked donated in the "
+             "lowered computation (input/output aliased)",
+             "PR 2 (donated fleet carry), PR 6 (donation-hold "
+             "protocol, PERF §11)"),
+    RuleInfo("no-transfer-in-scan", "jaxpr",
+             "no device_put / host callback primitives inside the "
+             "registered hot programs' scanned bodies",
+             "PR 6 (the three silent host/device serializers, "
+             "PERF §11)"),
+    RuleInfo("no-wall-clock-in-pure-paths", "ast",
+             "worlds/faults/traffic/scenarios draw only from seeded "
+             "(seed, idx) RNG keys; no time.* calls, no mutable RNG",
+             "PR 5/7/9 (digest-for-digest chaos and scenario replay)"),
+    RuleInfo("host-staging-is-numpy", "ast",
+             "schedule builders, host lane stacking, and checkpoint "
+             "snapshot/stitch stay free of jnp/eager device ops",
+             "PR 6 (eager-op queue serializer #2, PERF §11)"),
+    RuleInfo("no-inplace-on-host-views", "ast",
+             "no slice/ellipsis writes into arrays aliased from "
+             "result/metric attributes (host views of device arrays)",
+             "PR 5 (poison wrote into a read-only overlay metrics "
+             "view and validation never ran)"),
+    RuleInfo("cache-key-complete", "ast",
+             "every SimConfig field a traced builder reads is folded "
+             "into its compile-cache/bucket key or flows through the "
+             "Schedule arrays as data",
+             "PR 1/3 (plan-signature cache keys; stale-program class)"),
+    RuleInfo("no-recompile-steady-state", "guard",
+             "a warmed serving/bench lap triggers zero fresh XLA "
+             "compiles (compile-count budget)",
+             "PR 6 (first-lap discipline, PERF §11); bench.py --check"),
+    RuleInfo("no-implicit-transfer-in-resolve", "guard",
+             "device-resident segments (launched program + resolve) "
+             "perform no implicit host<->device transfers",
+             "PR 6 (resolve must be device-op-free; PERF §11)"),
+)
+
+
+def rule_names() -> list[str]:
+    return [r.name for r in RULES]
+
+
+def run_all(passes=("jaxpr", "ast"), rules=None) -> list[Finding]:
+    """Run the static passes and return every finding.
+
+    ``passes`` selects jaxpr / ast (the guard pass is runtime-shaped:
+    it runs inside bench.py --check and the tier-1 tests, not here —
+    but ``python -m gossip_protocol_tpu.analysis --pass guard`` runs
+    its self-check).  ``rules`` optionally restricts to a subset of
+    rule names.
+    """
+    findings: list[Finding] = []
+    if "jaxpr" in passes:
+        from . import jaxpr_audit
+        findings += jaxpr_audit.audit(rules=rules)
+    if "ast" in passes:
+        from . import purity_lint
+        findings += purity_lint.lint(rules=rules)
+        if rules is None or "cache-key-complete" in rules:
+            from . import cache_keys
+            findings += cache_keys.check()
+    if "guard" in passes:
+        from . import guards
+        findings += guards.self_check(rules=rules)
+    return findings
+
+
+__all__ = ["Finding", "RuleInfo", "RULES", "rule_names", "run_all"]

@@ -1,0 +1,112 @@
+"""CLI: ``python -m gossip_protocol_tpu.analysis``.
+
+Runs the three invariant passes over the tree and exits nonzero on
+any finding.  ``--list`` prints the rule catalog; ``--pass``/
+``--rule`` restrict the run (``make lint`` runs the two static
+passes; the guard pass self-checks its machinery — its real
+enforcement points are ``bench.py --check`` and tier-1).
+
+The jaxpr pass traces the lane-mesh programs, which need >= 2
+devices: virtual CPU devices are forced below BEFORE jax first
+imports, mirroring tests/conftest.py and the smoke scripts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _force_virtual_devices():
+    """Re-exec once with virtual CPU devices forced.
+
+    ``python -m gossip_protocol_tpu.analysis`` imports the parent
+    package (which imports jax) BEFORE this module runs, so setting
+    XLA_FLAGS here cannot take effect in-process — the mesh audit
+    entries would silently skip.  One guarded re-exec with the
+    corrected environment fixes it; explicit user-set flags are
+    respected as-is."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags \
+            or os.environ.get("_GOSSIP_ANALYSIS_REEXEC") == "1":
+        return
+    os.environ["_GOSSIP_ANALYSIS_REEXEC"] = "1"
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+    os.execv(sys.executable,
+             [sys.executable, "-m", "gossip_protocol_tpu.analysis"]
+             + sys.argv[1:])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m gossip_protocol_tpu.analysis",
+        description="static invariant analysis (docs/ANALYSIS.md)")
+    ap.add_argument("--pass", dest="passes", action="append",
+                    choices=("jaxpr", "ast", "guard"),
+                    help="run only this pass (repeatable; default: "
+                         "jaxpr + ast + guard)")
+    ap.add_argument("--rule", action="append",
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--list", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    from . import RULES, rule_names, run_all
+    if args.list:
+        for r in RULES:
+            print(f"{r.name:32s} [{r.pass_name}]  {r.protects}")
+            print(f"{'':32s}   origin: {r.origin}")
+        return 0
+
+    passes = tuple(args.passes) if args.passes \
+        else ("jaxpr", "ast", "guard")
+    rules = tuple(args.rule) if args.rule else None
+    if rules is not None:
+        # a typo'd --rule silently checking NOTHING while exiting 0
+        # would green-light a CI gate forever; reject it loudly, and
+        # reject a rule whose pass is deselected for the same reason
+        known = set(rule_names())
+        unknown = [r for r in rules if r not in known]
+        if unknown:
+            ap.error(f"unknown rule(s) {unknown}; see --list")
+        runnable = {r.name for r in RULES if r.pass_name in passes}
+        dead = [r for r in rules if r not in runnable]
+        if dead:
+            ap.error(f"rule(s) {dead} are not in the selected "
+                     f"pass(es) {list(passes)} — this run would "
+                     "check nothing; drop --pass or fix --rule")
+    findings = run_all(passes=passes, rules=rules)
+
+    active = [r.name for r in RULES
+              if r.pass_name in set(passes)
+              and (rules is None or r.name in rules)]
+    print(f"analysis: {len(active)} rule(s) over passes "
+          f"{'+'.join(passes)}: {', '.join(active)}")
+    if "jaxpr" in passes:
+        from .jaxpr_audit import audit as _audit
+        for p in _audit.last_programs:
+            state = "skipped" if p.jaxpr is None else \
+                f"{len(p.rules)} rule(s)"
+            note = f"  ({p.notes})" if p.notes else ""
+            print(f"  program {p.name}: {state}{note}")
+    if findings:
+        print(f"\n{len(findings)} finding(s):\n", file=sys.stderr)
+        for f in findings:
+            print(f"  {f}\n", file=sys.stderr)
+        return 1
+    print("clean: no findings")
+    return 0
+
+
+if __name__ == "__main__":
+    # must precede main(): re-execs once (never on plain import — a
+    # module-level execv would hijack any process that imports this
+    # file for its main())
+    _force_virtual_devices()
+    # `analysis --list | head` must not traceback on the closed pipe
+    import signal
+    signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+    raise SystemExit(main())

@@ -1,0 +1,223 @@
+"""Cache-key completeness: every config field a traced builder reads
+must be part of its compile-cache/bucket key — or flow through the
+Schedule arrays as data.
+
+The stale-program bug class: ``make_run``/``make_grid_run``/the fleet
+builders bake config values into compiled programs, and their cache
+keys (core/tick.make_run's key tuple, core/fleet.fleet_shape_key +
+models/segments.plan_signature + SimConfig.worlds_key, and the
+serving layer's service/bucket.bucket_key on top) must name every
+such value.  A field that a builder reads but no key folds in means
+two configs differing only in that field can be served ONE compiled
+program — wrong results with no error anywhere.  PR 1 introduced the
+plan-signature key component for exactly one such edit (a moved
+phase boundary); this pass generalizes the check to every SimConfig
+field by AST attribute-access scan.
+
+The sound set is::
+
+    fields_read(builders)  ⊆  fields_read(key functions)
+                              ∪ fields_read(schedule builders)
+
+because anything the schedule builders read flows into the Schedule
+arrays and enters the compiled program as *data* (per-call inputs),
+not baked constants.  The overlay tier keys the ENTIRE config
+(``fleet_shape_key`` bakes ``cfg.replace(seed=0)``), which this pass
+verifies structurally (the replace-marker must still be there) —
+that one line is what makes "the overlay compiles most of the config
+statically" safe at all.
+
+Reported findings name the missing field and every builder location
+that reads it.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+
+from . import Finding
+from ._astutil import REPO_ROOT, attr_chain
+from ..config import SimConfig
+
+SIM_FIELDS = frozenset(f.name for f in dataclasses.fields(SimConfig))
+
+#: property aliases that read like fields in the scanned source
+#: (``cfg.n`` IS ``cfg.max_nnb``, config.py)
+FIELD_ALIASES = {"n": "max_nnb"}
+
+#: names a SimConfig rides under in the scanned functions
+CFG_ROOTS = frozenset({"cfg", "c", "c0", "cw", "cfg_w", "gcfg",
+                       "lane_cfg", "fleet_cfg", "dcfg", "ocfg"})
+
+#: functions whose reads BAKE config into compiled programs
+BUILDER_FUNCS = {
+    "gossip_protocol_tpu/core/tick.py": (
+        "make_run", "make_tick"),
+    "gossip_protocol_tpu/core/dense_corner.py": (
+        "make_corner_run", "active_bound", "bench_stream_width"),
+    "gossip_protocol_tpu/core/dense_mega.py": (
+        "dense_mega_supported", "make_dense_mega_run"),
+    "gossip_protocol_tpu/core/fleet.py": (
+        "_shared_drop", "fleet_shape_key", "_dense_bench_fn",
+        "_dense_trace_fn", "launch", "launch_bench", "launch_leg",
+        "_overlay_launch", "_overlay_leg_launch",
+        "_dense_trace_leg_launch", "_overlay_fleet_fn", "_lane_cfgs"),
+    "gossip_protocol_tpu/models/overlay.py": (
+        "make_overlay_run", "make_overlay_tick",
+        "make_overlay_fleet_run"),
+    "gossip_protocol_tpu/models/overlay_grid.py": (
+        "make_grid_run", "make_grid_fleet_run", "grid_supported",
+        "_grid_kern_kwargs", "_step_frac"),
+    "gossip_protocol_tpu/models/overlay_mega.py": (
+        "mega_supported", "make_mega_run"),
+}
+
+#: functions whose reads form the CACHE/BUCKET KEYS
+KEY_FUNCS = {
+    "gossip_protocol_tpu/core/fleet.py": ("fleet_shape_key",),
+    "gossip_protocol_tpu/models/segments.py": (
+        "plan_signature", "phase_windows", "step_fraction",
+        "checkpoint_ticks"),
+    "gossip_protocol_tpu/config.py": ("worlds_key",),
+    "gossip_protocol_tpu/service/bucket.py": ("bucket_key",),
+    "gossip_protocol_tpu/core/dense_corner.py": ("active_bound",),
+}
+
+#: functions whose reads flow through the Schedule arrays as DATA
+DATA_FUNCS = {
+    "gossip_protocol_tpu/state.py": (
+        "make_schedule_host", "make_schedule", "init_state",
+        "slice_schedule"),
+    "gossip_protocol_tpu/models/overlay.py": (
+        "make_overlay_schedule", "resolved_dims",
+        "degree_thresholds", "init_overlay_state"),
+    "gossip_protocol_tpu/config.py": ("start_tick",),
+}
+
+#: every function in worlds.py is a schedule-data builder (the hashed
+#: node assignments are seed data; the windows are ALSO folded into
+#: plan_signature via phase_windows — both directions are covered)
+DATA_MODULES = ("gossip_protocol_tpu/worlds.py",)
+
+
+def _collect_reads(nodes, relfile, roots=CFG_ROOTS,
+                   self_cfg=True) -> dict:
+    """``{field: [file:line, ...]}`` of SimConfig attribute reads on
+    the given roots (plus ``self.<root>`` chains and bare ``self``
+    for config methods)."""
+    reads: dict = {}
+    for node in nodes:
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Attribute):
+                continue
+            chain = attr_chain(sub)
+            if chain:
+                chain[-1] = FIELD_ALIASES.get(chain[-1], chain[-1])
+            if not chain or chain[-1] not in SIM_FIELDS:
+                continue
+            root_ok = (chain[0] in roots
+                       or (self_cfg and len(chain) >= 2
+                           and chain[0] == "self"
+                           and (chain[1] in roots
+                                or len(chain) == 2)))
+            if not root_ok:
+                continue
+            reads.setdefault(chain[-1], []).append(
+                f"{relfile}:{sub.lineno}")
+    return reads
+
+
+def _find_funcs(tree, names):
+    found = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in names:
+            found.append(node)
+    return found
+
+
+def fields_read(spec: dict, whole_modules=()) -> dict:
+    """Union the per-function reads over a {relfile: (funcs,)} spec."""
+    reads: dict = {}
+    for relfile, funcs in spec.items():
+        path = os.path.join(REPO_ROOT, relfile)
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        nodes = _find_funcs(tree, set(funcs))
+        for fld, locs in _collect_reads(nodes, relfile).items():
+            reads.setdefault(fld, []).extend(locs)
+    for relfile in whole_modules:
+        path = os.path.join(REPO_ROOT, relfile)
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        for fld, locs in _collect_reads([tree], relfile).items():
+            reads.setdefault(fld, []).extend(locs)
+    return reads
+
+
+def fields_read_source(src: str, funcs, relfile="<fixture>.py") -> dict:
+    """Fixture entry: reads of an in-memory builder source."""
+    tree = ast.parse(src)
+    return _collect_reads(_find_funcs(tree, set(funcs)), relfile)
+
+
+def overlay_bakes_whole_config() -> bool:
+    """Structural pin: ``fleet_shape_key``'s overlay branch must still
+    key the ENTIRE config (``cfg.replace(seed=0)``) — the one line
+    that makes every overlay builder read key-covered."""
+    path = os.path.join(REPO_ROOT, "gossip_protocol_tpu/core/fleet.py")
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    for fn in _find_funcs(tree, {"fleet_shape_key"}):
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call) \
+                    and attr_chain(sub.func)[-1:] == ["replace"] \
+                    and [k.arg for k in sub.keywords] == ["seed"]:
+                return True
+    return False
+
+
+def builder_fields() -> dict:
+    return fields_read(BUILDER_FUNCS)
+
+
+def covered_fields() -> set:
+    covered = set(fields_read(KEY_FUNCS))
+    covered |= set(fields_read(DATA_FUNCS, whole_modules=DATA_MODULES))
+    # ``seed`` never keys anything by design: it flows through the
+    # Schedule arrays / per-lane PRNG keys on every path
+    covered.add("seed")
+    return covered
+
+
+def missing_fields(builders: dict | None = None,
+                   covered: set | None = None) -> dict:
+    """``{field: [builder locations]}`` read by builders but neither
+    key-folded nor schedule data."""
+    builders = builder_fields() if builders is None else builders
+    covered = covered_fields() if covered is None else covered
+    return {f: locs for f, locs in sorted(builders.items())
+            if f not in covered}
+
+
+def check() -> list[Finding]:
+    findings = []
+    if not overlay_bakes_whole_config():
+        findings.append(Finding(
+            "cache-key-complete",
+            "gossip_protocol_tpu/core/fleet.py:fleet_shape_key",
+            "the overlay branch no longer bakes cfg.replace(seed=0) "
+            "— every overlay builder read just lost its key "
+            "coverage; restore the whole-config key or enumerate "
+            "the overlay fields explicitly"))
+    for fld, locs in missing_fields().items():
+        findings.append(Finding(
+            "cache-key-complete", locs[0],
+            f"SimConfig.{fld} is read by a traced builder but folded "
+            "into NO cache key (fleet_shape_key / plan_signature / "
+            "worlds_key / bucket_key) and is not schedule data — two "
+            f"configs differing only in {fld!r} can be served one "
+            f"stale program (all readers: {', '.join(sorted(set(locs)))})"))
+    return findings

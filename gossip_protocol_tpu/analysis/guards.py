@@ -1,0 +1,208 @@
+"""Runtime guards: transfer and recompile invariants, enforced live.
+
+The static passes prove structure; these context managers prove the
+two runtime invariants the stack's serving numbers depend on:
+
+* **No implicit transfers in device-resident segments.**
+  :func:`no_implicit_transfers` wraps ``jax.transfer_guard`` — under
+  it, any *implicit* host->device conversion (a numpy array sliding
+  into a jitted call, an eager op on host data) raises, while the
+  explicit, intended transfers (``jax.device_put``/``device_get``,
+  the staged jit-call inputs placed before the guard) pass.  The
+  fleet resolve path is required to be device-op-free (PERF §11) —
+  a tier-1 test runs a small fleet's wait/resolve under this guard.
+
+* **Zero fresh compiles in a steady-state lap.**
+  :class:`CompileCounter` counts XLA compiles by filtering jax's
+  ``jax_log_compiles`` log records (and swallows them, so enabling
+  the counter does not spray WARNINGs); :func:`compile_budget`
+  raises :class:`RecompileBudget` when a block compiles more than
+  its budget.  ``bench.py --check`` runs a warmed bench lap under a
+  zero budget (:func:`steady_state_compile_gate`): a recompile in
+  steady state means a cache key regressed or a shape leaked —
+  the first-lap discipline of PERF §11 as a gate instead of a
+  measurement footnote.
+"""
+
+from __future__ import annotations
+
+import logging
+from contextlib import contextmanager
+
+from . import Finding
+
+#: the jax loggers that emit compile records under jax_log_compiles
+_JAX_COMPILE_LOGGERS = ("jax._src.interpreters.pxla",
+                        "jax._src.dispatch")
+
+
+class RecompileBudget(RuntimeError):
+    """A guarded block compiled more programs than its budget."""
+
+
+class CompileCounter(logging.Filter):
+    """Counts ``Compiling <name> ...`` records while attached.
+
+    Implemented as a logging *filter* on the emitting jax loggers:
+    filters see every record first and — by rejecting them — also
+    keep the temporarily-enabled ``jax_log_compiles`` WARNINGs out
+    of the user's terminal.  ``swallow=False`` lets them through
+    (debug mode).
+    """
+
+    def __init__(self, swallow: bool = True):
+        super().__init__()
+        self.swallow = swallow
+        self.names: list[str] = []
+
+    @property
+    def count(self) -> int:
+        return len(self.names)
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        msg = record.getMessage()
+        if msg.startswith("Compiling "):
+            self.names.append(msg.split(" ", 2)[1])
+        return not self.swallow
+
+
+@contextmanager
+def count_compiles(swallow: bool = True):
+    """Yield a :class:`CompileCounter` active for the block."""
+    import jax
+    counter = CompileCounter(swallow=swallow)
+    prev = jax.config.jax_log_compiles
+    jax.config.update("jax_log_compiles", True)
+    loggers = [logging.getLogger(n) for n in _JAX_COMPILE_LOGGERS]
+    for lg in loggers:
+        lg.addFilter(counter)
+    try:
+        yield counter
+    finally:
+        for lg in loggers:
+            lg.removeFilter(counter)
+        jax.config.update("jax_log_compiles", prev)
+
+
+@contextmanager
+def compile_budget(max_compiles: int = 0, what: str = "guarded block"):
+    """Raise :class:`RecompileBudget` when the block exceeds its
+    compile budget (0 = a fully warm path must stay warm)."""
+    with count_compiles() as counter:
+        yield counter
+    if counter.count > max_compiles:
+        raise RecompileBudget(
+            f"{what}: {counter.count} XLA compile(s) against a budget "
+            f"of {max_compiles} — compiled: {counter.names} (a steady-"
+            "state recompile means a cache key regressed or an input "
+            "shape leaked; see docs/ANALYSIS.md "
+            "no-recompile-steady-state)")
+
+
+@contextmanager
+def no_implicit_transfers():
+    """``jax.transfer_guard("disallow")``: implicit transfers raise,
+    explicit device_put/device_get pass.  Wrap device-resident
+    segments (an in-flight program's wait + resolve) with this."""
+    import jax
+    with jax.transfer_guard("disallow"):
+        yield
+
+
+def steady_state_compile_gate(inject_recompile: bool = False) -> dict:
+    """The bench.py --check recompile gate.
+
+    Builds the small overlay bench shape, warms it (one full
+    run_bench lap — compiles + eager-op programs), then runs TWO more
+    laps under a ZERO compile budget.  Returns
+    ``{"ok", "compiles", "compiled"}``; ``inject_recompile=True``
+    deliberately runs a fresh shape inside the guarded lap to prove
+    the gate trips (the acceptance fixture — bench.py exposes it as
+    ``--inject-recompile``).
+    """
+    from ..config import SimConfig
+    from ..models.overlay import OverlaySimulation
+    cfg = SimConfig(model="overlay", max_nnb=256, total_ticks=48,
+                    churn_rate=0.2, rejoin_after=None, seed=11,
+                    step_rate=8.0 / 256)
+    OverlaySimulation(cfg).run()                # warm lap (untimed)
+    # a second seed rides the SAME compiled program (the run cache
+    # keys config shape, seeds flow through the schedule) and warms
+    # any remaining eager-op programs
+    OverlaySimulation(cfg.replace(seed=12)).run()
+    try:
+        with compile_budget(0, what="steady-state bench lap") as c:
+            OverlaySimulation(cfg.replace(seed=13)).run()
+            OverlaySimulation(cfg.replace(seed=14)).run()
+            if inject_recompile:
+                # a FRESH shape mid-lap: guaranteed compile, proving
+                # the gate fires (never reached on the clean path)
+                OverlaySimulation(cfg.replace(max_nnb=128,
+                                              step_rate=8.0 / 128,
+                                              seed=15)).run()
+    except RecompileBudget as e:
+        return {"ok": False, "compiles": c.count, "compiled": c.names,
+                "detail": str(e)}
+    return {"ok": True, "compiles": c.count, "compiled": c.names}
+
+
+def self_check(rules=None) -> list[Finding]:
+    """CLI-facing guard-pass self check: the counter counts, the
+    budget trips, the transfer guard bites.  Proves the guard
+    machinery works in THIS process (the real enforcement points are
+    bench.py --check and the tier-1 tests)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    findings = []
+
+    def want(r):
+        return rules is None or r in rules
+
+    if want("no-recompile-steady-state"):
+        f = jax.jit(lambda x: x * 3 + 1)
+        f(jnp.ones(7))                          # warm
+        with count_compiles() as c:
+            f(jnp.ones(7))                      # warm call: 0 compiles
+        if c.count != 0:
+            findings.append(Finding(
+                "no-recompile-steady-state", "guards.self_check",
+                f"warm jit call counted {c.count} compiles — the "
+                "compile counter is broken on this jax version"))
+        tripped = False
+        try:
+            with compile_budget(0, what="self-check"):
+                f(jnp.ones(9))                  # fresh shape: compile
+        except RecompileBudget:
+            tripped = True
+        if not tripped:
+            findings.append(Finding(
+                "no-recompile-steady-state", "guards.self_check",
+                "an injected recompile did NOT trip the zero budget "
+                "— the bench.py --check gate would be blind"))
+
+    if want("no-implicit-transfer-in-resolve"):
+        g = jax.jit(lambda x: x + 1)
+        g(jnp.ones(3))                          # warm
+        bit = False
+        try:
+            with no_implicit_transfers():
+                g(np.ones(3))                   # implicit h2d
+        except Exception:
+            bit = True
+        if not bit:
+            findings.append(Finding(
+                "no-implicit-transfer-in-resolve", "guards.self_check",
+                "an implicit numpy->jit transfer passed under "
+                "transfer_guard('disallow') — the guard is inert on "
+                "this backend"))
+        try:
+            with no_implicit_transfers():
+                jax.device_get(g(jax.device_put(np.ones(3))))
+        except Exception as e:
+            findings.append(Finding(
+                "no-implicit-transfer-in-resolve", "guards.self_check",
+                f"explicit device_put/device_get raised under the "
+                f"guard ({e}) — the guard would flag the intended "
+                "staged transfers"))
+    return findings

@@ -1,0 +1,459 @@
+"""Jaxpr auditor: structural rules over the registered hot programs.
+
+The invariants this pass enforces are *lowering-shaped*: they are
+invisible in the Python source (the code says ``lax.cond`` either
+way) and only appear — or silently disappear — in the traced program.
+``jax.make_jaxpr`` of each registered hot program is walked
+recursively (into scan bodies, cond branches, pjit/shard_map inner
+jaxprs, and Pallas kernel jaxprs) and checked against named rules:
+
+``cond-stays-cond``
+    The windowed draws (the drop/partition window cond in
+    ops/drop.py, the overlay's SLOT_EPOCH re-slot cond) must lower to
+    REAL ``cond`` primitives.  Batching their predicate — a batched
+    clock, a per-lane drop plane — silently degrades them to
+    both-branches ``select_n``: the draw then runs on EVERY tick
+    (measured +43% wall for the re-slot, 2.6x the whole dense tick
+    for the drop draw — PERF §8/§9/§10).  Programs with a "batched
+    twin" (the fleet's SCHED_AXES_BATCHED build) are checked by
+    comparison — the shared-plane build must carry strictly more
+    conds; programs without a twin are checked against a minimum
+    cond count.  This generalizes (and now backs) the jaxpr string
+    grep that pinned the mesh drop plane in tests/test_fleet_mesh.py.
+
+``zero-collectives-per-tick``
+    No psum / all_gather / all_to_all / ppermute / reduce_scatter
+    anywhere in the lane-mesh programs (and none in the single-device
+    programs either, where they would be plain bugs).  Lane sharding
+    is zero-collective data parallelism by design (PERF §10); one
+    accidental cross-lane reduction turns every tick into a
+    synchronization point.
+
+``donation-taken``
+    Programs built with a donated scan carry (``donate_argnums``)
+    must actually alias that input to an output — the
+    ``tf.aliasing_output`` marker in the single-device MLIR, or
+    ``input_output_alias`` in the compiled executable for the
+    sharded path (shard_map plumbs donation at compile time with no
+    MLIR marker; verified on jax 0.4.37).  A donation that quietly
+    stops lowering (a dtype change, a broken alias) doubles the
+    resident state and — worse — changes the deletion semantics the
+    PendingFleet donation-hold protocol depends on (PERF §11).
+
+``no-transfer-in-scan``
+    No ``device_put`` / host-callback primitives inside the hot
+    programs.  A transfer inside the scanned body serializes every
+    tick on the host (the PERF §11 bug class, found by
+    instrumentation in PR 6).
+
+Programs are registered in :data:`PROGRAMS` with their provenance;
+each entry traces tiny configs (n=16 dense / n=64 overlay) so the
+audit stays test-tier fast.  Mesh programs need >= 2 devices — under
+``python -m gossip_protocol_tpu.analysis`` virtual CPU devices are
+forced before jax imports (__main__.py), mirroring tests/conftest.py;
+when fewer devices are live those entries are skipped with a notice
+rather than silently passing.
+"""
+
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass
+from typing import Optional
+
+from . import Finding
+
+#: cross-device collective primitives (by jaxpr primitive name) that
+#: must never appear in a lane-parallel tick body
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "psum2", "pmax", "pmin", "pmean", "ppermute", "pbroadcast",
+    "all_gather", "all_gather_invariant", "all_to_all",
+    "reduce_scatter", "pgather", "axis_all_gather",
+})
+
+#: transfer / host-callback primitives that must never appear inside
+#: a hot program (the scanned body especially)
+TRANSFER_PRIMS = frozenset({
+    "device_put", "copy_to_host", "pure_callback", "io_callback",
+    "debug_callback", "callback", "outside_call", "host_callback_call",
+    "infeed", "outfeed",
+})
+
+
+# ---- the jaxpr walker ------------------------------------------------
+def _sub_jaxprs(param_value):
+    """Sub-jaxprs hiding in one eqn param value (ClosedJaxpr, Jaxpr,
+    or a list/tuple of either — cond branches, scan/pjit bodies,
+    shard_map inner jaxprs, Pallas kernel jaxprs)."""
+    vals = param_value if isinstance(param_value, (list, tuple)) \
+        else (param_value,)
+    out = []
+    for v in vals:
+        if hasattr(v, "jaxpr"):         # ClosedJaxpr
+            out.append(v.jaxpr)
+        elif hasattr(v, "eqns"):        # raw Jaxpr
+            out.append(v)
+    return out
+
+
+def iter_eqns(jaxpr, path=()):
+    """Yield ``(path, eqn)`` for every equation, recursing into every
+    nested jaxpr (scan/cond/pjit/shard_map/pallas_call/custom_* —
+    anything that parks a Jaxpr in its params).  ``path`` is the
+    chain of enclosing primitives, e.g.
+    ``('pjit.jaxpr', 'scan.jaxpr', 'cond.branches')``."""
+    for eqn in jaxpr.eqns:
+        yield path, eqn
+        for k, v in eqn.params.items():
+            for sub in _sub_jaxprs(v):
+                yield from iter_eqns(
+                    sub, path + (f"{eqn.primitive.name}.{k}",))
+
+
+def prim_counts(closed_jaxpr) -> dict:
+    """Primitive-name histogram over the whole nested program."""
+    counts: dict = {}
+    for _, eqn in iter_eqns(closed_jaxpr.jaxpr):
+        counts[eqn.primitive.name] = counts.get(eqn.primitive.name, 0) + 1
+    return counts
+
+
+def find_prims(closed_jaxpr, names) -> list[tuple[str, str]]:
+    """``(path, primitive)`` of every occurrence of ``names``."""
+    hits = []
+    for path, eqn in iter_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name in names:
+            hits.append(("/".join(path) or "<top>", eqn.primitive.name))
+    return hits
+
+
+# ---- program registry ------------------------------------------------
+@dataclass
+class AuditedProgram:
+    """One registered hot program, traced and ready to check.
+
+    ``jaxpr`` is the traced program; ``twin`` (optional) is the
+    batched-plane build of the same program for the comparison form
+    of cond-stays-cond; ``min_cond`` the floor for the absolute
+    form."""
+
+    name: str
+    provenance: str
+    jaxpr: object
+    rules: tuple
+    twin: object = None
+    min_cond: int = 0
+    #: ``jax.stages.Lowered`` of the program when it declares a
+    #: donated carry (None otherwise).  The rule reads the pre-compile
+    #: MLIR first (single-device donation lowers as tf.aliasing_output
+    #: arg attrs) and falls back to compiling and reading the
+    #: executable's input_output_alias — the sharded path plumbs
+    #: donation at compile time, not in the MLIR (verified on jax
+    #: 0.4.37: shard_map carries alias buffers at runtime with no
+    #: MLIR marker).
+    lowered: object = None
+    notes: str = ""
+
+
+def _provenance(fn) -> str:
+    try:
+        f = inspect.unwrap(fn)
+        file = inspect.getsourcefile(f)
+        _, line = inspect.getsourcelines(f)
+        import os
+        rel = os.path.relpath(file, os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+        return f"{rel}:{line}"
+    except (TypeError, OSError):
+        return repr(fn)
+
+
+def _dense_cfg():
+    from ..config import SimConfig
+    return SimConfig(max_nnb=16, total_ticks=30, drop_msg=True,
+                     msg_drop_prob=0.1, single_failure=True)
+
+
+def _overlay_cfg():
+    from ..config import SimConfig
+    return SimConfig(model="overlay", max_nnb=64, total_ticks=96,
+                     churn_rate=0.2, rejoin_after=None, seed=1,
+                     step_rate=4.0 / 64)
+
+
+def _dense_fleet_args(cfg, shared: bool):
+    from ..core.fleet import _stack_scheds, _stack_states
+    from ..state import init_state, make_schedule
+    cfgs = [cfg.replace(seed=s) for s in (1, 2)]
+    scheds = [make_schedule(c) for c in cfgs]
+    states = _stack_states([init_state(c) for c in cfgs])
+    return states, _stack_scheds(scheds, shared)
+
+
+def _overlay_fleet_args(cfg):
+    from ..core.fleet import stack_lanes
+    from ..models.overlay import init_overlay_state, make_overlay_schedule
+    cfgs = [cfg.replace(seed=s) for s in (1, 2)]
+    states = stack_lanes([init_overlay_state(c) for c in cfgs])
+    states = states.replace(tick=init_overlay_state(cfgs[0]).tick)
+    scheds = stack_lanes([make_overlay_schedule(c) for c in cfgs])
+    return states, scheds
+
+
+def build_programs(mesh_devices: int = 2) -> list[AuditedProgram]:
+    """Trace the registered hot programs (tiny configs).
+
+    Covers the acceptance surface: solo tick (dense + overlay), fleet
+    scan (dense shared-vs-batched twin + overlay), the D=2 lane-mesh
+    ``shard_map`` program (dense twin pair + overlay), the grid
+    kernel, and the checkpoint-leg resume program.
+    """
+    import jax
+
+    from ..core.fleet import FleetSimulation
+    from ..core.tick import make_run
+    from ..models.overlay import (init_overlay_state, make_overlay_run,
+                                  make_overlay_fleet_run,
+                                  make_overlay_schedule)
+    from ..models.overlay_grid import make_grid_run
+    from ..models.segments import checkpoint_ticks
+    from ..state import init_state, make_schedule
+
+    progs: list[AuditedProgram] = []
+
+    # ---- solo dense trace (drop config: the ops/drop.py cond) -----
+    dcfg = _dense_cfg()
+    run = make_run(dcfg, with_events=True, use_pallas=False)
+    jx = jax.make_jaxpr(run)(init_state(dcfg), make_schedule(dcfg))
+    progs.append(AuditedProgram(
+        name="solo-dense-trace", provenance=_provenance(make_run),
+        jaxpr=jx, min_cond=1,
+        rules=("cond-stays-cond", "zero-collectives-per-tick",
+               "no-transfer-in-scan")))
+
+    # ---- solo overlay (SLOT_EPOCH re-slot cond) --------------------
+    ocfg = _overlay_cfg()
+    orun = make_overlay_run(ocfg, use_pallas=False)
+    ojx = jax.make_jaxpr(orun)(init_overlay_state(ocfg),
+                               make_overlay_schedule(ocfg))
+    progs.append(AuditedProgram(
+        name="solo-overlay", provenance=_provenance(make_overlay_run),
+        jaxpr=ojx, min_cond=1,
+        rules=("cond-stays-cond", "zero-collectives-per-tick",
+               "no-transfer-in-scan")))
+
+    # ---- fleet dense bench: shared-drop build vs batched twin ------
+    fs = FleetSimulation(dcfg)
+    dargs = _dense_fleet_args(dcfg, True)
+    dargs_b = _dense_fleet_args(dcfg, False)
+    frun = fs._dense_bench_fn(2, dcfg.n, True)
+    fjx = jax.make_jaxpr(frun)(*dargs)
+    ftwin = jax.make_jaxpr(fs._dense_bench_fn(2, dcfg.n, False))(
+        *dargs_b)
+    flow = frun.lower(*dargs)
+    progs.append(AuditedProgram(
+        name="fleet-dense-bench",
+        provenance=_provenance(FleetSimulation._dense_bench_fn),
+        jaxpr=fjx, twin=ftwin, min_cond=1, lowered=flow,
+        rules=("cond-stays-cond", "zero-collectives-per-tick",
+               "donation-taken", "no-transfer-in-scan")))
+
+    # ---- fleet overlay (vmap with the shared clock) ----------------
+    ofrun = make_overlay_fleet_run(ocfg, 2, use_pallas=False)
+    ofargs = _overlay_fleet_args(ocfg)
+    ofjx = jax.make_jaxpr(ofrun)(*ofargs)
+    oflow = ofrun.lower(*ofargs)
+    progs.append(AuditedProgram(
+        name="fleet-overlay",
+        provenance=_provenance(make_overlay_fleet_run),
+        jaxpr=ofjx, min_cond=1, lowered=oflow,
+        rules=("cond-stays-cond", "zero-collectives-per-tick",
+               "donation-taken", "no-transfer-in-scan")))
+
+    # ---- checkpoint-leg resume program (a cut-to-cut scan) ---------
+    cuts = checkpoint_ticks(ocfg)
+    if cuts:
+        start = cuts[0]
+        length = (cuts[1] - start) if len(cuts) > 1 \
+            else ocfg.total_ticks - start
+        lrun = make_overlay_fleet_run(ocfg, 2, length=length,
+                                      start_tick=start,
+                                      use_pallas=False)
+        # the XLA leg path reads the clock from the carried state, so
+        # tracing with the tick-0 carry is exact (the value is a
+        # traced arg, not baked)
+        ljx = jax.make_jaxpr(lrun)(*ofargs)
+        progs.append(AuditedProgram(
+            name="fleet-overlay-leg",
+            provenance=_provenance(make_overlay_fleet_run),
+            jaxpr=ljx, min_cond=1,
+            notes=f"leg [{start}, {start + length}) of "
+                  f"{ocfg.total_ticks}",
+            rules=("cond-stays-cond", "zero-collectives-per-tick",
+                   "no-transfer-in-scan")))
+
+    # ---- grid kernel (interpret off-TPU; pl.when lowers to cond) ---
+    gcfg = _overlay_cfg().replace(churn_rate=0.0, seed=3)
+    grun = make_grid_run(gcfg, 32, start_tick=None)
+    gjx = jax.make_jaxpr(grun)(init_overlay_state(gcfg),
+                               make_overlay_schedule(gcfg))
+    progs.append(AuditedProgram(
+        name="grid-kernel", provenance=_provenance(make_grid_run),
+        jaxpr=gjx, min_cond=1,
+        rules=("cond-stays-cond", "zero-collectives-per-tick",
+               "no-transfer-in-scan")))
+
+    # ---- lane-mesh programs (D=2) ----------------------------------
+    import jax as _jax
+    if _jax.device_count() >= mesh_devices:
+        from ..parallel.fleet_mesh import (MeshFleetSimulation,
+                                           make_lane_mesh)
+        mesh = make_lane_mesh(mesh_devices)
+        ms = MeshFleetSimulation(dcfg, mesh)
+        mrun = ms._dense_bench_fn(2, dcfg.n, True)
+        mjx = jax.make_jaxpr(mrun.jitted)(*dargs)
+        mtwin = jax.make_jaxpr(ms._dense_bench_fn(2, dcfg.n, False)
+                               .jitted)(*dargs_b)
+        mlow = mrun.jitted.lower(*dargs)
+        progs.append(AuditedProgram(
+            name=f"mesh-dense-bench-d{mesh_devices}",
+            provenance=_provenance(MeshFleetSimulation._dense_bench_fn),
+            jaxpr=mjx, twin=mtwin, min_cond=1, lowered=mlow,
+            rules=("cond-stays-cond", "zero-collectives-per-tick",
+                   "donation-taken", "no-transfer-in-scan")))
+
+        mos = MeshFleetSimulation(ocfg, mesh)
+        morun = mos._overlay_fleet_fn(2)
+        mojx = jax.make_jaxpr(morun.jitted)(*ofargs)
+        molow = morun.jitted.lower(*ofargs)
+        progs.append(AuditedProgram(
+            name=f"mesh-overlay-d{mesh_devices}",
+            provenance=_provenance(
+                MeshFleetSimulation._overlay_fleet_fn),
+            jaxpr=mojx, min_cond=1, lowered=molow,
+            rules=("cond-stays-cond", "zero-collectives-per-tick",
+                   "donation-taken", "no-transfer-in-scan")))
+    else:
+        progs.append(AuditedProgram(
+            name=f"mesh-(skipped: {_jax.device_count()} device(s) "
+                 f"live, need {mesh_devices})",
+            provenance="parallel/fleet_mesh.py", jaxpr=None, rules=(),
+            notes="force virtual devices: XLA_FLAGS="
+                  "--xla_force_host_platform_device_count=8 before "
+                  "jax imports (python -m gossip_protocol_tpu."
+                  "analysis does this itself)"))
+    return progs
+
+
+# ---- the rules -------------------------------------------------------
+def check_cond_stays_cond(prog: AuditedProgram) -> list[Finding]:
+    """Comparison form when a batched twin exists (the shared-plane
+    build must lower strictly more real conds than the batched one),
+    absolute form otherwise (>= min_cond conds present)."""
+    out = []
+    n_cond = prim_counts(prog.jaxpr).get("cond", 0)
+    if prog.twin is not None:
+        n_twin = prim_counts(prog.twin).get("cond", 0)
+        if not n_cond > n_twin:
+            out.append(Finding(
+                "cond-stays-cond", prog.name,
+                f"shared-plane program lowers {n_cond} cond(s) vs "
+                f"{n_twin} in the batched twin — the shared drop/"
+                "window plane no longer keeps its lax.cond a real "
+                "cond (the draw runs every tick as a both-branches "
+                "select; PERF §9/§10)",
+                path=prog.provenance))
+    if n_cond < prog.min_cond:
+        out.append(Finding(
+            "cond-stays-cond", prog.name,
+            f"expected >= {prog.min_cond} real cond primitive(s), "
+            f"found {n_cond} — a clock-/window-derived cond degraded "
+            "to a both-branches select_n (batched clock or batched "
+            "plane; PERF §8)",
+            path=prog.provenance))
+    return out
+
+
+def check_zero_collectives(prog: AuditedProgram) -> list[Finding]:
+    hits = find_prims(prog.jaxpr, COLLECTIVE_PRIMS)
+    return [Finding(
+        "zero-collectives-per-tick", prog.name,
+        f"collective primitive {name!r} in the tick program — lane "
+        "parallelism must move zero bytes between devices (PERF §10)",
+        path=p) for p, name in hits]
+
+
+def check_donation_taken(prog: AuditedProgram) -> list[Finding]:
+    if prog.lowered is None:
+        return []
+    # single-device donation shows as tf.aliasing_output arg attrs in
+    # the MLIR; the SHARDED path (shard_map under jit) plumbs it at
+    # compile time instead, so fall back to the executable's
+    # input_output_alias (the authoritative record either way)
+    if "tf.aliasing_output" in prog.lowered.as_text():
+        return []
+    if "input_output_alias" in prog.lowered.compile().as_text():
+        return []
+    return [Finding(
+        "donation-taken", prog.name,
+        "program declares a donated carry (donate_argnums) but "
+        "neither the lowering nor the compiled executable aliases "
+        "an input to an output — donation silently dropped (doubles "
+        "resident state and breaks the PendingFleet donation-hold "
+        "timing, PERF §11)",
+        path=prog.provenance)]
+
+
+def check_no_transfer(prog: AuditedProgram) -> list[Finding]:
+    hits = find_prims(prog.jaxpr, TRANSFER_PRIMS)
+    return [Finding(
+        "no-transfer-in-scan", prog.name,
+        f"transfer/callback primitive {name!r} inside the hot "
+        "program — every occurrence serializes the device on the "
+        "host (PERF §11's silent-serializer class)",
+        path=p) for p, name in hits]
+
+
+_RULE_FNS = {
+    "cond-stays-cond": check_cond_stays_cond,
+    "zero-collectives-per-tick": check_zero_collectives,
+    "donation-taken": check_donation_taken,
+    "no-transfer-in-scan": check_no_transfer,
+}
+
+
+def audit_program(prog: AuditedProgram, rules=None) -> list[Finding]:
+    """Apply the program's registered rules (optionally restricted)."""
+    if prog.jaxpr is None:        # a skipped registry entry
+        return []
+    out = []
+    for r in prog.rules:
+        if rules is not None and r not in rules:
+            continue
+        out += _RULE_FNS[r](prog)
+    return out
+
+
+def audit(rules=None, mesh_devices: int = 2,
+          programs=None) -> list[Finding]:
+    """Trace the registry and run every applicable rule.
+
+    The traced roster is kept on ``audit.last_programs`` so the CLI
+    can show what was covered (and, crucially, what was SKIPPED —
+    a mesh entry skipping for want of devices must be visible).
+    With a ``rules`` filter selecting NO jaxpr rule, the registry is
+    not traced at all (tracing + lowering the 8 programs costs ~8s —
+    a single-AST-rule run must not pay it)."""
+    if rules is not None and not set(rules) & set(_RULE_FNS):
+        audit.last_programs = []
+        return []
+    progs = build_programs(mesh_devices) if programs is None \
+        else programs
+    audit.last_programs = progs
+    findings = []
+    for p in progs:
+        findings += audit_program(p, rules=rules)
+    return findings
+
+
+audit.last_programs = []

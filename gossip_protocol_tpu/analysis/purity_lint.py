@@ -1,0 +1,435 @@
+"""AST purity lint: repo-specific source rules over the package.
+
+These rules guard invariants the jaxpr auditor cannot see because
+they live in HOST python, not in traced programs:
+
+``no-wall-clock-in-pure-paths``
+    The stochastic planes that must replay digest-for-digest —
+    worlds.py, service/faults.py, service/traffic.py,
+    models/scenarios.py — may draw randomness ONLY from a fresh
+    ``numpy.random.default_rng((seed, idx, ...))`` keyed by a tuple,
+    and may never call ``time.*`` or mutable/unseeded RNG in a draw
+    path.  (Injectable clocks passed as DEFAULT parameters —
+    ``now=time.perf_counter`` — are fine: the rule flags calls, not
+    references, which is exactly the seam the fake-clock tests use.)
+
+``host-staging-is-numpy``
+    The functions PERF §11 declares host-side — schedule builders,
+    host lane stacking, checkpoint snapshot/stitch — must stay free
+    of ``jnp.``/``jax.numpy`` usage: ONE eager jnp scalar on the pack
+    or resolve path dispatches a tiny XLA program that queues behind
+    the in-flight fleet program once the client's bounded in-flight
+    queue fills (serializer #2 of PERF §11).
+
+``no-inplace-on-host-views``
+    No slice/ellipsis writes into arrays aliased from result or
+    metric attributes.  Overlay metrics cross to host as READ-ONLY
+    numpy views of device arrays; PR 5's poison fault wrote into one
+    in place, raised ``ValueError`` before validation ever ran, and
+    the whole fault path silently changed meaning.  Writes into
+    freshly allocated locals (``np.zeros`` etc.) are fine; writes
+    through an attribute chain — or through a local bound via an
+    aliasing converter (``np.asarray(lane.metrics.sent)``,
+    ``.view()``, ``.reshape()``) — are flagged.
+
+Findings can be allowlisted in ``analysis/lint_allow.toml`` — every
+entry must carry a ``why`` (the file is the audit trail; an
+uncommented entry is itself a lint error).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+
+from . import Finding
+from ._astutil import REPO_ROOT, attr_chain as _attr_chain
+
+#: modules whose draws must be pure (seed, idx) functions
+PURE_PATH_MODULES = (
+    "gossip_protocol_tpu/worlds.py",
+    "gossip_protocol_tpu/service/faults.py",
+    "gossip_protocol_tpu/service/traffic.py",
+    "gossip_protocol_tpu/models/scenarios.py",
+)
+
+#: (module, function) pairs PERF §11 declares host-numpy-only:
+#: schedule builders, host lane stacking, checkpoint snapshot/stitch
+HOST_STAGING_FUNCS = {
+    "gossip_protocol_tpu/state.py": (
+        "make_schedule_host", "slice_schedule"),
+    "gossip_protocol_tpu/models/overlay.py": (
+        "make_overlay_schedule",),
+    "gossip_protocol_tpu/core/fleet.py": (
+        "stack_lanes_host", "_embed_state_host", "_lane_state",
+        "finish_lane", "_snapshot_lane", "_resume_states",
+        "_advance_checkpoints", "_dense_trace_lanes"),
+}
+
+#: modules checked for in-place writes on host views (the serving
+#: layer's result-handling surface plus the fleet resolve paths)
+HOST_VIEW_MODULES = (
+    "gossip_protocol_tpu/service/faults.py",
+    "gossip_protocol_tpu/service/resilience.py",
+    "gossip_protocol_tpu/service/scheduler.py",
+    "gossip_protocol_tpu/service/replay.py",
+    "gossip_protocol_tpu/service/loadbench.py",
+    "gossip_protocol_tpu/core/fleet.py",
+    "gossip_protocol_tpu/core/sim.py",
+)
+
+#: converters that can ALIAS their argument (a write through the
+#: result can mutate the argument's buffer)
+_ALIASING_CONVERTERS = frozenset({
+    "asarray", "asanyarray", "ascontiguousarray", "view", "reshape",
+    "ravel", "squeeze", "transpose", "atleast_1d", "atleast_2d",
+})
+
+
+# ---- allowlist -------------------------------------------------------
+@dataclass
+class AllowEntry:
+    rule: str
+    file: str
+    match: str   # substring of the offending source line
+    why: str
+
+
+def _parse_allow_toml(path: str) -> list[AllowEntry]:
+    """Minimal TOML-subset reader for lint_allow.toml (``[[allow]]``
+    tables of string keys) — python 3.10 has no tomllib and the
+    container must not grow dependencies."""
+    entries: list[AllowEntry] = []
+    cur: dict | None = None
+    if not os.path.exists(path):
+        return entries
+    with open(path) as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if line == "[[allow]]":
+                if cur:
+                    entries.append(AllowEntry(**cur))
+                cur = {}
+                continue
+            if "=" in line and cur is not None:
+                k, v = line.split("=", 1)
+                cur[k.strip()] = v.strip().strip('"')
+    if cur:
+        entries.append(AllowEntry(**cur))
+    return entries
+
+
+def load_allowlist() -> tuple[list[AllowEntry], list[Finding]]:
+    """The allowlist plus findings for malformed entries (an entry
+    without a ``why`` is itself a violation — the satellite contract:
+    the file is empty or every entry is justified)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint_allow.toml")
+    findings = []
+    try:
+        entries = _parse_allow_toml(path)
+    except TypeError as e:
+        return [], [Finding("allowlist", "analysis/lint_allow.toml",
+                            f"malformed entry: {e}")]
+    for e in entries:
+        if not e.why.strip():
+            findings.append(Finding(
+                "allowlist", "analysis/lint_allow.toml",
+                f"entry ({e.rule}, {e.file}, {e.match!r}) has no "
+                "'why' — every allowlisted finding must be justified"))
+    return entries, findings
+
+
+def _allowed(entries, rule: str, relfile: str, src_line: str) -> bool:
+    return any(e.rule == rule and e.file == relfile
+               and e.match and e.match in src_line for e in entries)
+
+
+# ---- shared AST helpers ----------------------------------------------
+def _read_lines(path: str) -> tuple[ast.Module, list[str]]:
+    with open(path) as f:
+        src = f.read()
+    return ast.parse(src, filename=path), src.splitlines()
+
+
+def _is_region_write(sub: ast.Subscript) -> bool:
+    """Slice / Ellipsis / tuple-containing-slice subscript — the
+    numpy region-write shapes (``x[...]``, ``x[:, 1]``, ``x[a:b]``)."""
+    sl = sub.slice
+    if isinstance(sl, ast.Slice):
+        return True
+    if isinstance(sl, ast.Constant) and sl.value is Ellipsis:
+        return True
+    if isinstance(sl, ast.Tuple):
+        return any(isinstance(e, ast.Slice)
+                   or (isinstance(e, ast.Constant)
+                       and e.value is Ellipsis)
+                   for e in sl.elts)
+    return False
+
+
+# ---- rule: no-wall-clock-in-pure-paths -------------------------------
+def _time_aliases(tree) -> tuple[set, set]:
+    """(module aliases of ``time``, names imported FROM time) — so
+    ``import time as t; t.sleep(...)`` and ``from time import
+    perf_counter; perf_counter()`` are caught like the ``time.X()``
+    attribute form."""
+    mods, names = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    mods.add(a.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                names.add(a.asname or a.name)
+    return mods, names
+
+
+def _check_pure_paths(tree, lines, relfile, allow) -> list[Finding]:
+    out = []
+    time_mods, time_names = _time_aliases(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) \
+            else ""
+        where = f"{relfile}:{node.lineno}"
+
+        def flag(detail):
+            if not _allowed(allow, "no-wall-clock-in-pure-paths",
+                            relfile, line):
+                out.append(Finding("no-wall-clock-in-pure-paths",
+                                   where, detail, path=line.strip()))
+
+        if len(chain) == 2 and chain[0] in (time_mods | {"time"}):
+            flag(f"call of time.{chain[1]} in a pure-replay path — "
+                 "wall time must enter through an injectable clock "
+                 "parameter, never a direct call")
+        elif len(chain) == 1 and chain[0] in time_names:
+            flag(f"call of {chain[0]} (imported from time) in a "
+                 "pure-replay path — wall time must enter through an "
+                 "injectable clock parameter, never a direct call")
+        elif len(chain) >= 2 and chain[-2:-1] == ["random"] \
+                and chain[0] in ("np", "numpy"):
+            fn = chain[-1]
+            if fn != "default_rng":
+                flag(f"np.random.{fn} draws from MUTABLE global RNG "
+                     "state — draw from a fresh "
+                     "default_rng((seed, idx)) instead")
+            elif not (node.args
+                      and isinstance(node.args[0], ast.Tuple)):
+                flag("default_rng() without a (seed, idx, ...) tuple "
+                     "key — the draw is not a pure function of its "
+                     "seed plane")
+        elif chain == ["default_rng"]:
+            if not (node.args and isinstance(node.args[0], ast.Tuple)):
+                flag("default_rng() without a (seed, idx, ...) tuple "
+                     "key — the draw is not a pure function of its "
+                     "seed plane")
+        elif chain[:1] == ["random"] and len(chain) == 2:
+            flag(f"stdlib random.{chain[1]} call — mutable global "
+                 "RNG in a replay path")
+    return out
+
+
+# ---- rule: host-staging-is-numpy -------------------------------------
+def _check_host_staging(tree, lines, relfile, funcs, allow
+                        ) -> list[Finding]:
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        if node.name not in funcs:
+            continue
+        for sub in ast.walk(node):
+            chain = []
+            if isinstance(sub, (ast.Attribute, ast.Name)):
+                chain = _attr_chain(sub)
+            if not chain:
+                continue
+            bad = None
+            if chain[0] == "jnp" and len(chain) > 1:
+                bad = ".".join(chain)
+            elif chain[:2] == ["jax", "numpy"]:
+                bad = ".".join(chain)
+            elif chain[:2] == ["jax", "device_put"]:
+                bad = "jax.device_put"
+            if bad:
+                line = lines[sub.lineno - 1] \
+                    if sub.lineno <= len(lines) else ""
+                if _allowed(allow, "host-staging-is-numpy", relfile,
+                            line):
+                    continue
+                out.append(Finding(
+                    "host-staging-is-numpy",
+                    f"{relfile}:{sub.lineno}",
+                    f"{bad} inside {node.name}() — this function is "
+                    "declared HOST-side (PERF §11): an eager device "
+                    "op here queues behind the in-flight fleet "
+                    "program and serializes the pipelined scheduler",
+                    path=node.name))
+                break   # one finding per offending function is enough
+    return out
+
+
+# ---- rule: no-inplace-on-host-views ----------------------------------
+def _check_host_views(tree, lines, relfile, allow) -> list[Finding]:
+    out = []
+
+    _MODS = ("np", "numpy", "jnp", "jax")
+
+    def aliasing_binding(v, aliased) -> bool:
+        """Does this RHS alias foreign (attribute-reached) memory?"""
+        if isinstance(v, ast.Attribute) and _attr_chain(v):
+            return True
+        if not isinstance(v, ast.Call):
+            return False
+        c = _attr_chain(v.func)
+        if not c or c[-1] not in _ALIASING_CONVERTERS:
+            return False
+        if c[0] in _MODS:
+            # free-function converter: aliases iff an argument is an
+            # attribute chain — np.asarray(lane.metrics.sent)
+            return any(isinstance(a, ast.Attribute) and _attr_chain(a)
+                       and _attr_chain(a)[0] not in _MODS
+                       for a in v.args)
+        # method-form converter (args or not): aliases iff the
+        # receiver is itself an attribute chain —
+        # lane.metrics.sent.reshape(2, 4) — or a local already known
+        # to alias (m2 = m.view()); a bare safe local's method
+        # (out.reshape(...)) stays clean
+        return len(c) > 2 or (len(c) == 2 and c[0] in aliased)
+
+    def visit(stmts, aliased: dict):
+        """In-order statement walk; each function gets a fresh local
+        alias map (a closure write-through is out of scope for this
+        lint — the allowlist is the escape hatch)."""
+        for node in stmts:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                visit(node.body, {})
+                continue
+            if isinstance(node, ast.ClassDef):
+                visit(node.body, {})
+                continue
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                tgts = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in tgts:
+                    if not (isinstance(tgt, ast.Subscript)
+                            and _is_region_write(tgt)):
+                        continue
+                    base = tgt.value
+                    hit = None
+                    if isinstance(base, ast.Attribute) and \
+                            _attr_chain(base) and \
+                            _attr_chain(base)[0] not in (
+                                "np", "numpy", "jnp", "jax", "self"):
+                        hit = ".".join(_attr_chain(base))
+                    elif isinstance(base, ast.Name) \
+                            and base.id in aliased:
+                        hit = (f"{base.id} (aliased from an attribute"
+                               f" at line {aliased[base.id]})")
+                    if hit is None:
+                        continue
+                    line = lines[node.lineno - 1] \
+                        if node.lineno <= len(lines) else ""
+                    if _allowed(allow, "no-inplace-on-host-views",
+                                relfile, line):
+                        continue
+                    out.append(Finding(
+                        "no-inplace-on-host-views",
+                        f"{relfile}:{node.lineno}",
+                        f"region write into {hit} — overlay/result "
+                        "metrics cross to host as read-only views of "
+                        "device arrays; REPLACE the array "
+                        "(.replace(field=new)) instead of writing "
+                        "into it (the PR-5 poison bug class)",
+                        path=line.strip()))
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    name = node.targets[0].id
+                    if aliasing_binding(node.value, aliased):
+                        aliased[name] = node.lineno
+                    else:
+                        aliased.pop(name, None)
+            # recurse into compound statements with the same scope
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(node, attr, None)
+                if sub and not isinstance(
+                        node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                    visit(sub, aliased)
+            for h in getattr(node, "handlers", []) or []:
+                visit(h.body, aliased)
+            for case in getattr(node, "cases", []) or []:
+                visit(case.body, aliased)   # match statements
+
+    visit(tree.body, {})
+    return out
+
+
+# ---- driver ----------------------------------------------------------
+def lint(rules=None) -> list[Finding]:
+    allow, findings = load_allowlist()
+
+    def want(r):
+        return rules is None or r in rules
+
+    if want("no-wall-clock-in-pure-paths"):
+        for rel in PURE_PATH_MODULES:
+            tree, lines = _read_lines(os.path.join(REPO_ROOT, rel))
+            findings += _check_pure_paths(tree, lines, rel, allow)
+    if want("host-staging-is-numpy"):
+        for rel, funcs in HOST_STAGING_FUNCS.items():
+            tree, lines = _read_lines(os.path.join(REPO_ROOT, rel))
+            findings += _check_host_staging(tree, lines, rel, funcs,
+                                            allow)
+    if want("no-inplace-on-host-views"):
+        for rel in HOST_VIEW_MODULES:
+            tree, lines = _read_lines(os.path.join(REPO_ROOT, rel))
+            findings += _check_host_views(tree, lines, rel, allow)
+    return findings
+
+
+def raw_findings(rule: str, relfile: str) -> list[Finding]:
+    """One rule over one repo file, allowlist IGNORED — the audit
+    trail's other half: tests use this to prove every allowlist entry
+    still masks a live finding (a stale entry hides nothing and must
+    be dropped), whatever rule the entry belongs to."""
+    tree, lines = _read_lines(os.path.join(REPO_ROOT, relfile))
+    if rule == "no-wall-clock-in-pure-paths":
+        return _check_pure_paths(tree, lines, relfile, [])
+    if rule == "host-staging-is-numpy":
+        return _check_host_staging(
+            tree, lines, relfile, HOST_STAGING_FUNCS.get(relfile, ()),
+            [])
+    if rule == "no-inplace-on-host-views":
+        return _check_host_views(tree, lines, relfile, [])
+    raise ValueError(f"unknown AST rule {rule!r}")
+
+
+# ---- fixture entry points (used by tests/test_analysis.py) -----------
+def lint_source(src: str, relfile: str = "<fixture>.py",
+                rule: str = "no-wall-clock-in-pure-paths",
+                staging_funcs=()) -> list[Finding]:
+    """Run ONE rule over an in-memory source string — the violation
+    fixtures prove each rule actually fires without planting broken
+    code in the tree."""
+    tree = ast.parse(src)
+    lines = src.splitlines()
+    if rule == "no-wall-clock-in-pure-paths":
+        return _check_pure_paths(tree, lines, relfile, [])
+    if rule == "host-staging-is-numpy":
+        return _check_host_staging(tree, lines, relfile,
+                                   tuple(staging_funcs), [])
+    if rule == "no-inplace-on-host-views":
+        return _check_host_views(tree, lines, relfile, [])
+    raise ValueError(f"unknown AST rule {rule!r}")

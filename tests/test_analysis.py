@@ -1,0 +1,401 @@
+"""The invariant analyzer (gossip_protocol_tpu/analysis/) — PR 10.
+
+Two-sided contract, both directions tested:
+
+* the CLEAN TREE passes every pass (jaxpr audit over the registered
+  hot programs, AST purity lint + allowlist hygiene, cache-key
+  completeness, runtime guards);
+* every rule FIRES on a synthetic violation — a batched-clock fleet,
+  a batched drop plane, a psum in the tick body, a device_put/
+  callback in the scanned body, a dropped donation, a jnp-using
+  staging fn, an unseeded rng, an in-place write on a host view, an
+  unkeyed builder field, an injected steady-state recompile, an
+  implicit transfer.  A rule that cannot fire protects nothing.
+
+conftest forces 8 virtual CPU devices, so the mesh audit entries run
+here exactly as they do under ``python -m gossip_protocol_tpu
+.analysis`` (which re-execs itself to force the same flags).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gossip_protocol_tpu.analysis import (RULES, Finding, jaxpr_audit,
+                                          purity_lint, rule_names,
+                                          run_all)
+from gossip_protocol_tpu.analysis import cache_keys, guards
+from gossip_protocol_tpu.config import SimConfig
+
+
+def needs_devices(d):
+    return pytest.mark.skipif(
+        jax.device_count() < d, reason=f"needs {d} (virtual) devices")
+
+
+# ---- the catalog itself ----------------------------------------------
+def test_rule_catalog_names_at_least_eight_rules():
+    """Acceptance: >= 8 named rules across the jaxpr/AST/guard passes,
+    each with a motivating origin."""
+    names = rule_names()
+    assert len(names) >= 8
+    assert len(set(names)) == len(names)
+    for r in RULES:
+        assert r.pass_name in ("jaxpr", "ast", "guard")
+        assert r.protects and r.origin
+
+
+# ---- clean tree ------------------------------------------------------
+def test_clean_tree_passes_ast_rules():
+    assert purity_lint.lint() == []
+    assert cache_keys.check() == []
+
+
+def test_allowlist_entries_are_justified():
+    """Satellite: lint_allow.toml is empty or every entry carries a
+    why — and every entry actually MASKS a live finding (a stale
+    entry is clutter that hides nothing)."""
+    entries, findings = purity_lint.load_allowlist()
+    assert findings == []
+    for e in entries:
+        raw = purity_lint.raw_findings(e.rule, e.file)
+        assert any(e.match in f.path for f in raw), (
+            f"allowlist entry {e.match!r} masks nothing in {e.file} — "
+            "drop the stale entry")
+
+
+def test_clean_tree_passes_jaxpr_audit():
+    """The registered hot programs (solo dense/overlay, fleet pair,
+    leg resume, grid kernel, and — with devices — the D=2 mesh pair)
+    carry their conds, zero collectives, live donations, and no
+    transfers.  This is the tier-1 twin of the CLI's jaxpr pass."""
+    findings = jaxpr_audit.audit()
+    assert findings == [], "\n".join(str(f) for f in findings)
+    names = [p.name for p in jaxpr_audit.audit.last_programs]
+    for expected in ("solo-dense-trace", "solo-overlay",
+                     "fleet-dense-bench", "fleet-overlay",
+                     "fleet-overlay-leg", "grid-kernel"):
+        assert expected in names
+    if jax.device_count() >= 2:
+        assert "mesh-dense-bench-d2" in names
+        assert "mesh-overlay-d2" in names
+
+
+# ---- jaxpr rule fixtures ---------------------------------------------
+def _overlay_fixture_cfg():
+    return SimConfig(model="overlay", max_nnb=16, total_ticks=32,
+                     seed=5, step_rate=4.0 / 16)
+
+
+def _batched_clock_jaxpr():
+    """The PR-2 regression in miniature: vmap the overlay tick with
+    the CLOCK batched (tick=0 instead of the shared None scalar) —
+    the SLOT_EPOCH re-slot cond degrades to a both-branches select."""
+    from gossip_protocol_tpu.models.overlay import (
+        OVERLAY_FLEET_STATE_AXES, init_overlay_state,
+        make_overlay_schedule, make_overlay_tick)
+    cfg = _overlay_fixture_cfg()
+    tick = make_overlay_tick(cfg, use_pallas=False, with_coverage=False)
+    bad_axes = OVERLAY_FLEET_STATE_AXES.replace(tick=0)
+    vtick = jax.vmap(tick, in_axes=(bad_axes, 0),
+                     out_axes=(bad_axes, 0))
+
+    @jax.jit
+    def run(states, scheds):
+        def step(carry, _):
+            return vtick(carry, scheds)
+        return jax.lax.scan(step, states, None, length=cfg.total_ticks)
+
+    from gossip_protocol_tpu.core.fleet import stack_lanes
+    cfgs = [cfg.replace(seed=s) for s in (1, 2)]
+    states = stack_lanes([init_overlay_state(c) for c in cfgs])
+    # batched clock: every lane carries its own tick scalar
+    scheds = stack_lanes([make_overlay_schedule(c) for c in cfgs])
+    return jax.make_jaxpr(run)(states, scheds)
+
+
+def test_batched_clock_fleet_is_caught():
+    jx = _batched_clock_jaxpr()
+    prog = jaxpr_audit.AuditedProgram(
+        name="fixture-batched-clock", provenance="test_analysis",
+        jaxpr=jx, min_cond=1, rules=("cond-stays-cond",))
+    findings = jaxpr_audit.audit_program(prog)
+    assert findings and findings[0].rule == "cond-stays-cond"
+    # sanity: the SHARED-clock build of the same program is clean
+    from gossip_protocol_tpu.models.overlay import (
+        init_overlay_state, make_overlay_fleet_run,
+        make_overlay_schedule)
+    from gossip_protocol_tpu.core.fleet import stack_lanes
+    cfg = _overlay_fixture_cfg()
+    run = make_overlay_fleet_run(cfg, 2, use_pallas=False)
+    cfgs = [cfg.replace(seed=s) for s in (1, 2)]
+    states = stack_lanes([init_overlay_state(c) for c in cfgs])
+    states = states.replace(tick=init_overlay_state(cfgs[0]).tick)
+    scheds = stack_lanes([make_overlay_schedule(c) for c in cfgs])
+    good = jaxpr_audit.AuditedProgram(
+        name="fixture-shared-clock", provenance="test_analysis",
+        jaxpr=jax.make_jaxpr(run)(states, scheds), min_cond=1,
+        rules=("cond-stays-cond",))
+    assert jaxpr_audit.audit_program(good) == []
+
+
+@needs_devices(2)
+def test_collective_in_tick_body_is_caught():
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from gossip_protocol_tpu.compat.jaxapi import shard_map
+    mesh = Mesh(np.array(jax.devices()[:2]), ("lanes",))
+
+    def body(x):
+        return x + jax.lax.psum(x.sum(), "lanes")
+
+    f = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("lanes"),),
+                          out_specs=P("lanes")))
+    jx = jax.make_jaxpr(f)(jnp.ones((2, 4)))
+    prog = jaxpr_audit.AuditedProgram(
+        name="fixture-psum", provenance="test_analysis", jaxpr=jx,
+        rules=("zero-collectives-per-tick",))
+    findings = jaxpr_audit.audit_program(prog)
+    assert findings and findings[0].rule == "zero-collectives-per-tick"
+    assert "psum" in findings[0].detail
+
+
+def test_transfer_and_callback_in_scan_are_caught():
+    def step_put(c, _):
+        return jax.device_put(c) + 1, None
+
+    def step_dbg(c, _):
+        jax.debug.print("tick {}", c[0])
+        return c + 1, None
+
+    for step, prim in ((step_put, "device_put"),
+                       (step_dbg, "debug_callback")):
+        f = jax.jit(lambda x, _s=step: jax.lax.scan(_s, x, None,
+                                                    length=3))
+        jx = jax.make_jaxpr(f)(jnp.ones(3))
+        prog = jaxpr_audit.AuditedProgram(
+            name=f"fixture-{prim}", provenance="test_analysis",
+            jaxpr=jx, rules=("no-transfer-in-scan",))
+        findings = jaxpr_audit.audit_program(prog)
+        assert findings and findings[0].rule == "no-transfer-in-scan"
+        assert prim in findings[0].detail
+
+
+def test_dropped_donation_is_caught():
+    """A program registered as donating whose jit does NOT donate:
+    neither the MLIR marker nor a compiled alias exists — the rule
+    must flag it (and pass the genuinely-donating twin)."""
+    f_no = jax.jit(lambda x: x * 2.0)
+    f_do = jax.jit(lambda x: x * 2.0, donate_argnums=(0,))
+    x = jnp.ones((8,))
+    bad = jaxpr_audit.AuditedProgram(
+        name="fixture-no-donate", provenance="test_analysis",
+        jaxpr=jax.make_jaxpr(f_no)(x), lowered=f_no.lower(x),
+        rules=("donation-taken",))
+    findings = jaxpr_audit.audit_program(bad)
+    assert findings and findings[0].rule == "donation-taken"
+    good = jaxpr_audit.AuditedProgram(
+        name="fixture-donate", provenance="test_analysis",
+        jaxpr=jax.make_jaxpr(f_do)(x), lowered=f_do.lower(x),
+        rules=("donation-taken",))
+    assert jaxpr_audit.audit_program(good) == []
+
+
+def test_walker_reaches_nested_and_pallas_jaxprs():
+    """The recursive eqn walk must see through pjit/scan/cond nesting
+    — the grid-kernel registry entry additionally proves pallas_call
+    kernel jaxprs are walked (its conds live INSIDE the kernel)."""
+    @jax.jit
+    def f(x):
+        def step(c, _):
+            c = jax.lax.cond(c[0] > 0, lambda v: v + 1,
+                             lambda v: v - 1, c)
+            return c, None
+        return jax.lax.scan(step, x, None, length=2)
+
+    jx = jax.make_jaxpr(f)(jnp.ones(3))
+    counts = jaxpr_audit.prim_counts(jx)
+    assert counts.get("cond", 0) >= 1
+    hits = jaxpr_audit.find_prims(jx, {"cond"})
+    assert any("scan" in p for p, _ in hits), hits
+
+
+# ---- AST rule fixtures -----------------------------------------------
+def test_unseeded_rng_and_wall_clock_are_caught():
+    src = """
+import time
+import time as clk
+from time import perf_counter
+import numpy as np
+from numpy.random import default_rng
+
+def bad_draw(seed, idx):
+    rng = np.random.default_rng()           # unseeded
+    r2 = np.random.default_rng(seed)        # non-tuple key
+    r3 = default_rng()                      # bare import, unseeded
+    u = np.random.random()                  # mutable global RNG
+    t = time.perf_counter()                 # wall clock call
+    t2 = perf_counter()                     # from-import escape
+    t3 = clk.monotonic()                    # module-alias escape
+    return rng, r2, r3, u, t, t2, t3
+
+def good_draw(seed, idx, now=time.perf_counter):
+    rng = np.random.default_rng((seed, idx))
+    return rng.random()
+"""
+    findings = purity_lint.lint_source(
+        src, rule="no-wall-clock-in-pure-paths")
+    assert len(findings) == 7, [str(f) for f in findings]
+    # the injectable-clock DEFAULT and the tuple-keyed draw are clean
+    assert not any("good_draw" in f.path for f in findings)
+
+
+def test_jnp_in_staging_function_is_caught():
+    src = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def stage_lanes_host(trees):
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+
+def stage_lanes_host_np(trees):
+    return jax.tree.map(lambda *xs: np.stack(xs), *trees)
+"""
+    findings = purity_lint.lint_source(
+        src, rule="host-staging-is-numpy",
+        staging_funcs=("stage_lanes_host", "stage_lanes_host_np"))
+    assert len(findings) == 1
+    assert findings[0].rule == "host-staging-is-numpy"
+    assert findings[0].path == "stage_lanes_host"
+
+
+def test_inplace_write_on_host_view_is_caught():
+    src = """
+import numpy as np
+
+def poison_direct(lane):
+    lane.metrics.sent[:] = -1               # the PR-5 bug, verbatim
+
+def poison_via_alias(lane):
+    sent = np.asarray(lane.metrics.sent)
+    sent[...] = -1                          # aliased view write
+
+def poison_via_method(lane):
+    m = lane.metrics.sent.reshape(2, -1)    # method-form alias
+    m[:] = 0
+    m2 = np.asarray(lane.metrics.recv)
+    m3 = m2.view()                          # alias-of-alias
+    m3[...] = 1
+
+def fine(lane, key, y):
+    out = np.zeros(8)
+    out[:4] = 1                             # fresh local: fine
+    lane.chunks[-1] = y                     # list slot swap: fine
+    table = {}
+    table[key] = y                          # dict write: fine
+    safe = np.array(lane.metrics.sent)      # np.array COPIES
+    safe[:] = 0
+    v = out.reshape(2, 4)                   # safe-local reshape: fine
+    v[:] = 1
+"""
+    findings = purity_lint.lint_source(
+        src, rule="no-inplace-on-host-views")
+    assert len(findings) == 4, [str(f) for f in findings]
+    assert {f.where.split(":")[-1] for f in findings} == \
+        {"5", "9", "13", "16"}
+
+
+# ---- cache-key completeness ------------------------------------------
+def test_cache_key_scan_sees_builder_reads():
+    """The AST scan actually collects the known builder reads —
+    including the ``cfg.n`` property alias of max_nnb — and the
+    covered set contains them (the clean-tree assertion is
+    test_clean_tree_passes_ast_rules)."""
+    builders = cache_keys.builder_fields()
+    for fld in ("max_nnb", "total_ticks", "t_remove", "model",
+                "zombie", "flap_rate"):
+        assert fld in builders, f"builder scan lost {fld}"
+    covered = cache_keys.covered_fields()
+    assert set(builders) <= covered
+    assert cache_keys.overlay_bakes_whole_config()
+
+
+def test_unkeyed_builder_field_fails_with_its_name():
+    """Satellite: the diff FAILS naming the missing field.  A fixture
+    builder reads a real field; with that field stripped from the
+    covered set the check reports it (builder locations included)."""
+    fixture = cache_keys.fields_read_source("""
+def make_fixture_run(cfg):
+    horizon = cfg.total_ticks
+    window = cfg.drop_open_tick
+    return horizon + window
+""", funcs=("make_fixture_run",))
+    assert set(fixture) == {"total_ticks", "drop_open_tick"}
+    missing = cache_keys.missing_fields(
+        builders=fixture,
+        covered=cache_keys.covered_fields() - {"drop_open_tick"})
+    assert set(missing) == {"drop_open_tick"}
+    assert missing["drop_open_tick"] == fixture["drop_open_tick"]
+
+
+# ---- runtime guards --------------------------------------------------
+def test_compile_counter_counts_and_budget_trips():
+    f = jax.jit(lambda x: x * 5 + 2)
+    f(jnp.ones(11))                          # warm
+    with guards.count_compiles() as c:
+        f(jnp.ones(11))
+    assert c.count == 0
+    with guards.count_compiles() as c:
+        f(jnp.ones(13))                      # fresh shape
+    assert c.count >= 1
+    with pytest.raises(guards.RecompileBudget, match="budget"):
+        with guards.compile_budget(0):
+            f(jnp.ones(17))
+
+
+def test_steady_state_compile_gate_clean_and_injected():
+    """The bench.py --check gate: a warmed bench lap stays at zero
+    compiles; an injected recompile trips it (acceptance: bench.py
+    --check fails on an injected steady-state recompile and passes
+    clean — bench exposes the injection as --inject-recompile)."""
+    clean = guards.steady_state_compile_gate()
+    assert clean["ok"], clean
+    assert clean["compiles"] == 0
+    tripped = guards.steady_state_compile_gate(inject_recompile=True)
+    assert not tripped["ok"]
+    assert tripped["compiles"] >= 1
+
+
+def test_fleet_resolve_is_free_of_implicit_transfers():
+    """A small replay's device-resident segment under
+    ``transfer_guard("disallow")``: the launched fleet's wait +
+    resolve must perform only EXPLICIT transfers (device_get) — an
+    eager jnp op on host data or a numpy arg sliding into a jitted
+    helper would raise here (PERF §11 serializer class)."""
+    from gossip_protocol_tpu.core.fleet import FleetSimulation
+    cfg = _overlay_fixture_cfg()
+    fleet = FleetSimulation(cfg)
+    pending = fleet.launch(seeds=[1, 2], warmup=True)
+    with guards.no_implicit_transfers():
+        pending.wait()
+        result = pending.resolve()
+    assert len(result.lanes) == 2
+    # the guard itself must BITE on this backend: an implicit
+    # numpy->jit transfer raises under the same guard
+    g = jax.jit(lambda x: x + 1)
+    g(jnp.ones(3))
+    with pytest.raises(Exception, match="[Dd]isallow"):
+        with guards.no_implicit_transfers():
+            g(np.ones(3))
+
+
+def test_guard_self_check_is_clean():
+    assert guards.self_check() == []
+
+
+# ---- the whole front door --------------------------------------------
+def test_run_all_static_passes_clean():
+    findings = run_all(passes=("ast",))
+    assert findings == [], "\n".join(str(f) for f in findings)

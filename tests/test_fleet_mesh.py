@@ -181,23 +181,45 @@ def test_mesh_shared_drop_plane_keeps_cond():
     drop plane replicated, the drop draw stays a real ``lax.cond`` in
     the mesh program's jaxpr; batching the plane per lane erases the
     cond (both branches inlined under a select) — the 2.6x regression
-    PERF §9 measured.  Pinned by op-count, not wall clock."""
+    PERF §9 measured.  Pinned by op-count, not wall clock.
+
+    Since PR 10 the pin is enforced by the jaxpr auditor's
+    ``cond-stays-cond`` rule (gossip_protocol_tpu/analysis/
+    jaxpr_audit.py) over the registered ``mesh-dense-bench-d2``
+    program; this wrapper keeps the original test name — and the
+    string-grep history it carries — findable while delegating the
+    actual check (recursive eqn walk instead of the old ``"cond["``
+    substring count) to the rule engine."""
+    from gossip_protocol_tpu.analysis import jaxpr_audit
     cfg = _dense_drop(n=16, ticks=30)
     sim = MeshFleetSimulation(cfg, make_lane_mesh(2))
     cfgs = [cfg.replace(seed=s) for s in (1, 2)]
     scheds = [make_schedule(c) for c in cfgs]
-    states = _stack_states([init_state(c) for c in cfgs])
 
     shared = sim._dense_bench_fn(2, cfg.n, True)
-    jx_shared = str(jax.make_jaxpr(shared.jitted)(
-        states, _stack_scheds(scheds, True)))
-    states = _stack_states([init_state(c) for c in cfgs])
+    jx_shared = jax.make_jaxpr(shared.jitted)(
+        _stack_states([init_state(c) for c in cfgs]),
+        _stack_scheds(scheds, True))
     batched = sim._dense_bench_fn(2, cfg.n, False)
-    jx_batched = str(jax.make_jaxpr(batched.jitted)(
-        states, _stack_scheds(scheds, False)))
-    assert jx_shared.count("cond[") > jx_batched.count("cond["), (
+    jx_batched = jax.make_jaxpr(batched.jitted)(
+        _stack_states([init_state(c) for c in cfgs]),
+        _stack_scheds(scheds, False))
+    prog = jaxpr_audit.AuditedProgram(
+        name="mesh-dense-bench-d2", provenance="tests/test_fleet_mesh",
+        jaxpr=jx_shared, twin=jx_batched, min_cond=1,
+        rules=("cond-stays-cond",))
+    assert jaxpr_audit.audit_program(prog) == [], (
         "replicated drop plane no longer lowers to a real cond — the "
         "drop draw is running every tick as a both-branches select")
+    # and the rule itself must BITE: a program whose plane batched
+    # (the twin standing in for both builds) is a violation
+    broken = jaxpr_audit.AuditedProgram(
+        name="mesh-dense-bench-d2-batched",
+        provenance="tests/test_fleet_mesh",
+        jaxpr=jx_batched, twin=jx_batched, min_cond=1,
+        rules=("cond-stays-cond",))
+    assert jaxpr_audit.audit_program(broken), (
+        "cond-stays-cond did not fire on a batched-plane program")
 
 
 # ---- batch/mesh geometry ---------------------------------------------
