@@ -649,6 +649,27 @@ def main():
             "parity_checked": el["parity_checked"],
             "env": _env_provenance(),
         }
+        # durable serving (PR 12, gossip_protocol_tpu/store/): the
+        # kill-and-restart acceptance gate at full scale — the
+        # acceptance stream served against a run directory (write-
+        # ahead journal + content-addressed checkpoint spill) in a
+        # SUBPROCESS that dies via os._exit mid-run, then recovered
+        # here.  kill_restart_replay raises unless every request is
+        # terminal exactly once across the two processes,
+        # restarted_lanes == 0, and every per-request result content
+        # digest matches an uninterrupted baseline run — this entry
+        # existing IS the gate (at non-smoke scale: 204 requests).
+        from gossip_protocol_tpu.store.harness import \
+            kill_restart_replay
+        seeds_rc = 2 if smoke else 34
+        rc, _ = kill_restart_replay(seeds_per_template=seeds_rc,
+                                    n_overlay=n_sv, t_overlay=t_sv,
+                                    max_batch=8, checkpoint_every=48,
+                                    kill_frac=0.5)
+        rc.pop("run_dir", None)      # a tmp path, not provenance
+        rc["durability"].pop("run_dir", None)
+        rc["env"] = _env_provenance()
+        secondary["service_recovery"] = rc
         if jax.device_count() > 1:
             # lane-mesh serving (parallel/fleet_mesh.py) at EQUAL total
             # lane width: max_batch is per-device and d must DIVIDE

@@ -64,7 +64,28 @@ HOST_STAGING_FUNCS = {
     "gossip_protocol_tpu/core/fleet.py": (
         "stack_lanes_host", "_embed_state_host", "_lane_state",
         "finish_lane", "_snapshot_lane", "_resume_states",
-        "_advance_checkpoints", "_dense_trace_lanes"),
+        "_advance_checkpoints", "_dense_trace_lanes",
+        # durable-serving snapshot (de)serialization (PR 12): the
+        # spill tier's flatten/rebuild must stay host numpy — a jnp
+        # leaf here would put device transfers on the crash-recovery
+        # path and break the bit-identity contract
+        "checkpoint_arrays", "checkpoint_from_arrays"),
+    # the durability subsystem (PR 12, gossip_protocol_tpu/store/):
+    # every spill/restore/journal path is host numpy + file IO by
+    # contract — recovery must work on a machine with no devices warm
+    "gossip_protocol_tpu/store/spill.py": (
+        "_arrays_sha", "checkpoint_digest_from_arrays", "save_spill",
+        "read_spill", "verify_spill", "inspect_spill", "_spill",
+        "ref", "fetch", "materialize"),
+    "gossip_protocol_tpu/store/journal.py": (
+        "_append", "meta", "submit", "cut", "fault", "outcome",
+        "recover_mark", "read_journal"),
+    "gossip_protocol_tpu/store/recovery.py": (
+        "recover_service",),
+    "gossip_protocol_tpu/service/replay.py": (
+        # the journal's per-result content digest rides the resolve
+        # path (scheduler _complete_batch) — host numpy only
+        "result_digest",),
 }
 
 #: modules checked for in-place writes on host views (the serving
@@ -77,6 +98,13 @@ HOST_VIEW_MODULES = (
     "gossip_protocol_tpu/service/loadbench.py",
     "gossip_protocol_tpu/core/fleet.py",
     "gossip_protocol_tpu/core/sim.py",
+    # the durability subsystem (PR 12): a spilled snapshot's arrays
+    # are handed straight back into fleet dispatch — an in-place
+    # write anywhere in the store would corrupt resumable state
+    "gossip_protocol_tpu/store/spill.py",
+    "gossip_protocol_tpu/store/journal.py",
+    "gossip_protocol_tpu/store/recovery.py",
+    "gossip_protocol_tpu/store/harness.py",
 )
 
 #: converters that can ALIAS their argument (a write through the

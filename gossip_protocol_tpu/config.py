@@ -250,6 +250,32 @@ class SimConfig:
     def replace(self, **kw) -> "SimConfig":
         return dataclasses.replace(self, **kw)
 
+    # --- journal serialization (store/journal.py) -------------------
+    def to_dict(self) -> dict:
+        """JSON-ready field dict.
+
+        Every field is an int/float/bool/str/None scalar, so
+        ``json.dumps(cfg.to_dict())`` round-trips exactly (Python's
+        float repr is lossless) — the write-ahead journal and the
+        spilled-checkpoint headers (gossip_protocol_tpu/store/) both
+        persist configs this way and must get back an ``==`` config.
+        """
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SimConfig":
+        """Inverse of :meth:`to_dict`.
+
+        Unknown keys are dropped rather than rejected so a journal
+        written by a NEWER config schema still replays on an older
+        one (the surviving fields keep their recorded values; missing
+        fields take defaults) — recovery re-validates results by
+        digest, so a semantic mismatch fails loudly downstream
+        instead of here.
+        """
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
     # --- legacy .conf ingestion -------------------------------------
     @classmethod
     def from_conf(cls, path: str, **overrides) -> "SimConfig":

@@ -436,10 +436,17 @@ class LaneCheckpoint:
         return self.tick >= self.cfg.total_ticks
 
     def digest(self) -> str:
-        """Stable short hash of the snapshot (clock + carry bytes)."""
+        """Stable short hash of the snapshot (clock + config + carry
+        bytes).  The FULL config is folded in, not just the seed:
+        lanes of different scenario variants can carry bit-identical
+        state early in a run (a failure that has not fired yet), and
+        their snapshots must not share a content address — they
+        resume into different futures.  The durable spill tier
+        (store/spill.py) keys files by this digest."""
         import hashlib
         h = hashlib.sha256()
-        h.update(repr((self.tick, self.cfg.seed, self.mode)).encode())
+        h.update(repr((self.tick, self.mode)).encode())
+        h.update(repr(sorted(self.cfg.to_dict().items())).encode())
         for name in sorted(self.state):
             h.update(name.encode())
             h.update(np.ascontiguousarray(self.state[name]).tobytes())
@@ -483,6 +490,76 @@ def finish_lane(ck: LaneCheckpoint):
         rejoin_tick=np.asarray(sched.rejoin_tick),
         added=added, removed=removed, sent=sent, recv=recv,
         final_state=final, wall_seconds=ck.wall_seconds)
+
+
+#: per-chunk array names of a dense trace chunk, in tuple order
+#: (``LaneCheckpoint.chunks`` docstring above)
+_DENSE_CHUNK_FIELDS = ("added", "removed", "sent", "recv")
+
+
+def checkpoint_arrays(ck: LaneCheckpoint):
+    """Flatten one :class:`LaneCheckpoint` into ``(meta, arrays)``.
+
+    ``meta`` is a JSON-safe dict (config via ``SimConfig.to_dict``,
+    clock, legs, chunk field order, and the snapshot's own
+    :meth:`~LaneCheckpoint.digest`); ``arrays`` maps
+    ``state/<field>`` and ``chunk/<j>/<field>`` to the snapshot's
+    host-numpy leaves.  Pure host work — the durable spill tier
+    (store/spill.py) writes exactly this pair to an npz, and
+    :func:`checkpoint_from_arrays` rebuilds a bit-identical snapshot
+    (digest-stable, so the spill file's content address survives the
+    round trip).  ``mesh_desc`` is deliberately NOT serialized: a
+    checkpoint is mesh-independent, and a reloaded one carries
+    ``mesh_desc=None`` (the serving layer counts its next dispatch as
+    a migration at most — never a correctness event).
+    """
+    arrays = {f"state/{k}": np.asarray(v) for k, v in ck.state.items()}
+    chunk_fields = []
+    for j, chunk in enumerate(ck.chunks):
+        if hasattr(chunk, "sent") and not isinstance(chunk, tuple):
+            names = tuple(f.name for f in dataclasses.fields(chunk))
+            vals = [np.asarray(getattr(chunk, n)) for n in names]
+        else:
+            names = _DENSE_CHUNK_FIELDS
+            vals = [np.asarray(v) for v in chunk]
+        chunk_fields.append(list(names))
+        for n, v in zip(names, vals):
+            arrays[f"chunk/{j}/{n}"] = v
+    meta = {"version": 1, "cfg": ck.cfg.to_dict(), "mode": ck.mode,
+            "tick": int(ck.tick), "legs": int(ck.legs),
+            "wall_seconds": float(ck.wall_seconds),
+            "model": ck.cfg.model, "n_chunks": len(ck.chunks),
+            "chunk_fields": chunk_fields, "digest": ck.digest()}
+    return meta, arrays
+
+
+def checkpoint_from_arrays(meta: dict, arrays: dict) -> LaneCheckpoint:
+    """Inverse of :func:`checkpoint_arrays` (host numpy only).
+
+    Overlay chunks are rebuilt as ``OverlayMetrics`` structs from the
+    recorded field order; dense chunks as ``(added, removed, sent,
+    recv)`` tuples.  The caller (store/spill.py ``fetch``) re-derives
+    :meth:`LaneCheckpoint.digest` on the result and compares it to
+    the file's content address, so a corrupted or mislabeled spill
+    can never silently re-enter a fleet.
+    """
+    cfg = SimConfig.from_dict(meta["cfg"])
+    state = {k.split("/", 1)[1]: np.asarray(v)
+             for k, v in arrays.items() if k.startswith("state/")}
+    chunks = []
+    for j in range(meta["n_chunks"]):
+        names = meta["chunk_fields"][j]
+        vals = [np.asarray(arrays[f"chunk/{j}/{n}"]) for n in names]
+        if cfg.model == "overlay":
+            from ..models.overlay import OverlayMetrics
+            chunks.append(OverlayMetrics(**dict(zip(names, vals))))
+        else:
+            chunks.append(tuple(vals))
+    return LaneCheckpoint(cfg=cfg, mode=meta["mode"],
+                          tick=int(meta["tick"]), state=state,
+                          chunks=chunks,
+                          wall_seconds=float(meta["wall_seconds"]),
+                          legs=int(meta["legs"]), mesh_desc=None)
 
 
 @dataclass

@@ -24,7 +24,7 @@ from .faults import (FAULT_KINDS, FaultInjector, InjectedCompileFailure,
                      InjectedFault)
 from .replay import (Template, build_trace, chaos_replay,
                      elastic_replay, grader_templates,
-                     overlay_templates, replay)
+                     overlay_templates, replay, result_digest)
 from .resilience import (BreakerPolicy, BucketQuarantined, CircuitBreaker,
                          DeadlineExceeded, DispatchFailed,
                          PoisonedLaneError, RetryPolicy, ServiceError,
@@ -59,4 +59,7 @@ __all__ = [
     # the elasticity plane (PR 8): mesh grow + segment-boundary
     # checkpointing + in-flight lane migration
     "elastic_replay", "solo_resume", "validate_checkpoint",
+    # the durability plane (PR 12, gossip_protocol_tpu/store/):
+    # per-result content digests for the journal + recovery gates
+    "result_digest",
 ]

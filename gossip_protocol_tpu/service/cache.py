@@ -144,6 +144,14 @@ class ProgramCache:
         self.mesh_rebinds += 1
         return n
 
+    def keys(self) -> tuple:
+        """The current ``(mesh descriptor, bucket key)`` entries, LRU
+        order (oldest first).  Read-only observability: crash
+        recovery (store/recovery.py) journals how many bucket handles
+        its re-warm pass materialized, and tests assert the recovered
+        cache covers every re-admitted bucket."""
+        return tuple(self._sims)
+
     @property
     def builds(self) -> int:
         """Whole-run builds observed since this cache was created.

@@ -177,6 +177,43 @@ def _mismatch(tpl: Template, ref, got) -> str | None:
     return None
 
 
+def result_digest(res) -> str:
+    """Stable content hash of ONE request's result — exactly the
+    parity fields ``_mismatch`` compares, so two results with equal
+    digests are bit-identical by the replay harness's own standard.
+
+    This is what the write-ahead journal records per terminal request
+    (store/journal.py ``outcome``): a run killed after a request
+    completed can still prove that request's bit-parity against an
+    uninterrupted baseline without the result surviving the death.
+    Pure host numpy (registered under the purity lint's host-staging
+    rule).
+    """
+    import hashlib
+    h = hashlib.sha256()
+
+    def _fold(tag: str, a) -> None:
+        h.update(tag.encode())
+        if a is None:
+            h.update(b"<none>")
+            return
+        a = np.ascontiguousarray(np.asarray(a))
+        h.update(repr((a.shape, str(a.dtype))).encode())
+        h.update(a.tobytes())
+
+    if hasattr(res, "metrics"):           # overlay result
+        for f in _OV_STATE:
+            _fold(f"state.{f}", getattr(res.final_state, f))
+        for f in _OV_METRICS:
+            _fold(f"metrics.{f}", getattr(res.metrics, f))
+    else:                                 # dense result (trace/bench)
+        for f in ("added", "removed", "sent", "recv"):
+            _fold(f, getattr(res, f))
+        for f in _DENSE_STATE:
+            _fold(f"state.{f}", getattr(res.final_state, f))
+    return h.hexdigest()[:16]
+
+
 def verify_parity(trace, seq_results, svc_results) -> list[str]:
     """Per-request bit-parity of the two legs; returns mismatches."""
     bad = []

@@ -301,6 +301,11 @@ def solo_resume(req):
 
     from ..core.fleet import finish_lane
     ck = req.resume
+    if hasattr(ck, "load"):
+        # durable serving: req.resume is a lightweight
+        # store/spill.SpilledCheckpoint proxy — fetch the real
+        # snapshot (RAM hit or validated disk reload)
+        ck = ck.load()
     cfg = ck.cfg
     if cfg.model == "overlay":
         from ..models.overlay import (OverlaySimulation,

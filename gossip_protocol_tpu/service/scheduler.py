@@ -171,7 +171,8 @@ class FleetService:
                  tenant_quota: Optional[int] = None,
                  pump_harvest: Optional[bool] = None,
                  checkpoint_every: Optional[int] = None,
-                 checkpoint_every_s: Optional[float] = None):
+                 checkpoint_every_s: Optional[float] = None,
+                 store=None, run_dir: Optional[str] = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if pad_policy not in PAD_POLICIES:
@@ -341,6 +342,26 @@ class FleetService:
             "lanes_migrated": 0, "resume_dispatches": 0,
             "restarted_lanes": 0,
         }
+        #: the durability plane (PR 12, gossip_protocol_tpu/store/):
+        #: a RunStore (or ``run_dir`` sugar for one) makes this
+        #: service journal every decision and write every checkpoint
+        #: cut through the content-addressed spill tier — queued
+        #: requests then hold lightweight SpilledCheckpoint proxies
+        #: instead of full snapshots, and ``FleetService.recover``
+        #: can rebuild the run in a fresh process.  None (default):
+        #: the pre-PR-12 in-RAM-only behavior, bit for bit.
+        if run_dir is not None and store is None:
+            from ..store import RunStore
+            store = RunStore(run_dir)
+        self.store = store
+        if store is not None:
+            store.journal.meta({
+                "max_batch": max_batch, "pad_policy": pad_policy,
+                "pipeline": self.pipeline,
+                "checkpoint_every": checkpoint_every,
+                "checkpoint_every_s": checkpoint_every_s,
+                "mesh_devices": self.n_devices,
+            })
 
     # ---- admission ---------------------------------------------------
     def submit(self, cfg: SimConfig, seed: Optional[int] = None,
@@ -422,8 +443,59 @@ class FleetService:
         self._bucket_stats.setdefault(key, {"requests": 0, "dispatches": 0,
                                             "builds": 0})
         self._bucket_stats[key]["requests"] += 1
+        if self.store is not None:
+            self.store.journal.submit(req)
         self.pump()
         return handle
+
+    def _readmit(self, rid: int, cfg: SimConfig, mode: str,
+                 priority: str = "default",
+                 tenant: Optional[str] = None,
+                 resume=None) -> RequestHandle:
+        """Re-admit one journaled request during crash recovery
+        (store/recovery.py) under its ORIGINAL rid.
+
+        Mirrors :meth:`submit`'s bookkeeping with three deliberate
+        differences: no new journal record (the original submit
+        record stands — a second recovery must not see duplicates),
+        no admission control (the request was already admitted once;
+        shedding it now would drop accepted work), and no ``pump()``
+        (recovery queues everything first so resumed batches re-form
+        at full width).  ``resume`` is the lane's latest loadable
+        spilled cut (a SpilledCheckpoint proxy) — the request queues
+        directly under the matching resume sub-bucket, exactly where
+        the dead process left it.
+        """
+        key = bucket_key(cfg, mode)
+        req = SimRequest(rid=rid, cfg=cfg, mode=mode, bucket=key,
+                         submit_s=self.clock(), priority=priority,
+                         tenant=tenant)
+        if resume is not None:
+            req.resume = resume
+            req.bucket = key + (("resume", int(resume.tick)),)
+        handle = RequestHandle(request=req, _service=self)
+        self._handles[rid] = handle
+        self._queues.setdefault(req.bucket, deque()).append(req)
+        self._tenant_note(tenant, +1)
+        self._filler.setdefault(key, cfg)
+        self._bucket_stats.setdefault(key, {"requests": 0,
+                                            "dispatches": 0,
+                                            "builds": 0})
+        self._bucket_stats[key]["requests"] += 1
+        self._next_rid = max(self._next_rid, rid + 1)
+        return handle
+
+    @classmethod
+    def recover(cls, run_dir: str, mesh=None, **kw):
+        """Rebuild a service (and its pending work) from a dead
+        process's run directory: replay the write-ahead journal,
+        re-warm the program cache, re-admit every non-terminal
+        request, and resume each from its last spilled cut.  Returns
+        ``(service, handles)``; drive the service (``drain()`` /
+        per-handle ``result()``) to finish the run.  Full semantics:
+        store/recovery.py."""
+        from ..store.recovery import recover_service
+        return recover_service(run_dir, mesh=mesh, **kw)
 
     @property
     def capacity(self) -> int:
@@ -957,6 +1029,8 @@ class FleetService:
                  if self.injector is not None else None)
         if fault is not None:
             self._failures["faults_injected"] += 1
+            if self.store is not None:
+                self.store.journal.fault(idx, fault)
         return idx, fault
 
     def _try_once(self, key: tuple, reqs: list, t_q0: float,
@@ -1057,6 +1131,11 @@ class FleetService:
                         if ck.mesh_desc != sim._mesh_entry())
             self._elastic["lanes_migrated"] += moved
             self._elastic["resume_dispatches"] += 1
+            if self.store is not None:
+                # durable serving: queued requests hold lightweight
+                # spill proxies — materialize the real snapshots for
+                # dispatch (RAM hit or validated disk reload)
+                cks = [self.store.materialize(ck) for ck in cks]
             pending = sim.launch_leg(resume=cks, ticks=leg,
                                      width=width, defer=defer)
             return pending, width
@@ -1144,7 +1223,12 @@ class FleetService:
         sub = base + (("resume", leg.checkpoints[0].tick),)
         q = self._queues.setdefault(sub, deque())
         for req, ck in zip(reqs, leg.checkpoints):
-            req.resume = ck
+            # durable serving (PR 12): the cut is journaled and the
+            # snapshot write-through-spilled; the request queues with
+            # the lightweight proxy so the store's RAM LRU is the
+            # ONLY place full snapshots accumulate
+            req.resume = ck if self.store is None \
+                else self.store.put(req.rid, ck)
             req.bucket = sub
             self._handles[req.rid]._launched = False
             q.append(req)
@@ -1214,6 +1298,8 @@ class FleetService:
                 self._failures["deadline_misses"] += 1
             legs = req.resume.legs + 1 if req.resume is not None else 1
             req.resume = None       # the run is over; free the snapshot
+            if self.store is not None:
+                self.store.journal.outcome(req.rid, "completed", lane)
             self._handles.pop(req.rid)._complete(lane, RequestMetrics(
                 rid=req.rid, bucket=base, mode=req.mode,
                 queue_wait_s=t_q0 - req.submit_s, run_wall_s=wall,
@@ -1284,6 +1370,8 @@ class FleetService:
                 self._failures["deadline_misses"] += 1
             self._failures["degraded_requests"] += 1
             req.resume = None
+            if self.store is not None:
+                self.store.journal.outcome(req.rid, "degraded", res)
             self._handles.pop(req.rid)._complete(res, RequestMetrics(
                 rid=req.rid, bucket=self._base_key(key), mode=req.mode,
                 queue_wait_s=t_q0 - req.submit_s,
@@ -1339,6 +1427,9 @@ class FleetService:
         self._failed += 1
         self._failures["failed_requests"] += 1
         self._class_stat(req.priority)["failed"] += 1
+        if self.store is not None:
+            self.store.journal.outcome(req.rid, "failed",
+                                       error=type(error).__name__)
         self._handles.pop(req.rid)._fail(error)
 
     def _drop_expired(self, reqs: list, now: float) -> list:
@@ -1596,6 +1687,12 @@ class FleetService:
             "elastic": dict(self._elastic),
             "checkpoint_every": self.checkpoint_every,
             "checkpoint_every_s": self.checkpoint_every_s,
+            # the durability plane (PR 12, gossip_protocol_tpu/store/):
+            # spill/journal/recovery counters when a RunStore rides;
+            # None on a store-less service (the key is always present
+            # so dashboards need no schema branch)
+            "durability": (self.store.stats()
+                           if self.store is not None else None),
         }
         # per-priority-class view: each class's OWN windowed
         # percentiles + lifetime terminal counters (completed counts

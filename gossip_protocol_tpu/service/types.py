@@ -54,6 +54,10 @@ class SimRequest:
     #: Set by the scheduler when a non-final leg resolves; the request
     #: then re-queues under a resume sub-bucket and its next dispatch
     #: re-enters the scan from this snapshot — never from tick 0.
+    #: With a spill tier attached (PR 12, ``FleetService(run_dir=)``)
+    #: this may be a store.spill.SpilledCheckpoint proxy instead of a
+    #: resident LaneCheckpoint — same digest/cfg/tick surface, state
+    #: loaded (and validated) from disk only at dispatch.
     #: Cleared at completion.
     resume: Optional[object] = None
 

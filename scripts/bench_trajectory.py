@@ -6,10 +6,11 @@ folds them into one table — headline node-ticks/s, fleet batching
 speedup, serving replay speedup (best recorded: mixed / mesh / the
 204-request curve's top row), p95 latency, device-wait fraction, the
 chaos gate, the open-loop load columns (max achieved rps + measured
-saturation point, PR 7+), and the scenario-frontier columns (variants
-graded + oracle pass rate, PR 9+; older jsons without an entry render
-"-") — so a regression (or a claimed win) is visible at a glance,
-PR over PR.
+saturation point, PR 7+), the scenario-frontier columns (variants
+graded + oracle pass rate, PR 9+), and the durable-serving columns
+(kill/restart completion + spill volume, PR 12+; older jsons without
+an entry render "-") — so a regression (or a claimed win) is visible
+at a glance, PR over PR.
 
     PYTHONPATH=. python scripts/bench_trajectory.py          # table
     PYTHONPATH=. python scripts/bench_trajectory.py --json   # rows
@@ -98,6 +99,10 @@ def load_rows():
         # scenario-frontier entry (PR 9+): the adversarial-world sweep
         # graded as one service run; absent in earlier jsons -> "-"
         scen = sec.get("scenario_sweep") or {}
+        # durable-serving entry (PR 12+): the kill-and-restart gate —
+        # completion across the death, zero restarts, digest parity,
+        # and the spill tier's write volume
+        recov = sec.get("service_recovery") or {}
         rows.append({
             "pr": pr,
             "backend": d.get("backend"),
@@ -122,6 +127,14 @@ def load_rows():
             "scenario_variants": scen.get("variants"),
             "scenario_pass_rate": scen.get("oracle_pass_rate"),
             "scenario_replayed": scen.get("replayed_digest_for_digest"),
+            "recovery_completion": recov.get("completion_rate"),
+            "recovery_restarted": recov.get("restarted_lanes"),
+            "recovery_digest_match": recov.get("digest_match"),
+            "recovery_spills": _get(recov, "durability", "spills"),
+            "recovery_spill_mb": (
+                _get(recov, "durability", "spill_bytes") / 1e6
+                if _get(recov, "durability", "spill_bytes") is not None
+                else None),
         })
     return rows
 
@@ -155,7 +168,9 @@ def main(argv) -> int:
             ("load rps", "load_max_achieved_rps", "{:.1f}"),
             ("sat rps", "load_saturation_rps", "{:.1f}"),
             ("scen", "scenario_variants", "{}"),
-            ("scen ok", "scenario_pass_rate", "{:.0%}")]
+            ("scen ok", "scenario_pass_rate", "{:.0%}"),
+            ("recov", "recovery_completion", "{:.0%}"),
+            ("spill MB", "recovery_spill_mb", "{:.1f}")]
     table = [[_fmt(r.get(key), spec) for _, key, spec in cols]
              for r in rows]
     widths = [max(len(h), *(len(t[i]) for t in table))
