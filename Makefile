@@ -31,13 +31,20 @@ clean:
 	rm -f $(NATIVE_OBJS) native/gossip_app.o Application libgossip_native.so \
 	      dbg.log stats.log msgcount.log
 
-# Static invariant analysis (PR 10, docs/ANALYSIS.md): the jaxpr audit
-# over the registered hot programs + the AST purity/cache-key passes.
-# Exits nonzero on any finding.  The runtime guard pass is enforced by
-# `python bench.py --check` (compile budget) and tier-1 (transfer
-# guard); `python -m gossip_protocol_tpu.analysis` alone runs all
-# three.
+# Static invariant analysis (PR 10/14, docs/ANALYSIS.md): the jaxpr
+# audit over the registered hot programs + the sharding-flow per-axis
+# collective pass (the 2-D mesh gate) + the AST purity/cache-key
+# passes.  Exits nonzero on any finding.  The runtime guard pass is
+# enforced by `python bench.py --check` (compile budget) and tier-1
+# (transfer guard); `python -m gossip_protocol_tpu.analysis` alone
+# runs all four.
 lint:
-	JAX_PLATFORMS=cpu python -m gossip_protocol_tpu.analysis --pass jaxpr --pass ast
+	JAX_PLATFORMS=cpu python -m gossip_protocol_tpu.analysis --pass jaxpr --pass sharding --pass ast
 
-.PHONY: all clean lint
+# Same three static passes, one machine-readable JSON document on
+# stdout (findings + covered-program roster) for CI and
+# scripts/bench_trajectory.py.
+lint-json:
+	@JAX_PLATFORMS=cpu python -m gossip_protocol_tpu.analysis --pass jaxpr --pass sharding --pass ast --json
+
+.PHONY: all clean lint lint-json

@@ -806,12 +806,19 @@ def main():
         "headline": _overlay_entry(overlay, backend),
         "secondary": secondary,
     }
+    if "--check" in sys.argv:
+        # fold the static-analysis verdict into the payload BEFORE it
+        # prints, so the committed BENCH_pr*.json records the lint
+        # state of the tree that produced the numbers
+        # (bench_trajectory renders the findings/rules columns)
+        payload["analysis"] = analysis_summary()
     print(json.dumps(payload))
     if "--check" in sys.argv:
         rc = check_regression(payload)
         rc_compiles = check_steady_state_compiles(
             inject="--inject-recompile" in sys.argv)
-        sys.exit(rc or rc_compiles)
+        rc_lint = check_static_analysis(payload["analysis"])
+        sys.exit(rc or rc_compiles or rc_lint)
 
 
 #: --check fails the run when the fresh headline falls more than this
@@ -876,6 +883,43 @@ def check_steady_state_compiles(inject: bool = False) -> int:
     print(f"bench --check compiles: FAIL — {res['compiles']} fresh "
           f"compile(s) in the steady-state lap: "
           f"{res.get('compiled', [])}", file=sys.stderr)
+    return 1
+
+
+def analysis_summary() -> dict:
+    """Static-analysis section of the --check payload (PR 14): the
+    jaxpr + sharding-flow + AST passes run in-process and their
+    verdict rides the committed BENCH json — findings count, rule
+    inventory size, and how many registry programs were actually
+    traced vs skipped (a bench box without 8 virtual devices skips
+    the mesh entries; that must be visible, not read as coverage)."""
+    from gossip_protocol_tpu.analysis import RULES, run_all
+    from gossip_protocol_tpu.analysis.jaxpr_audit import audit
+    findings = run_all(passes=("jaxpr", "sharding", "ast"))
+    skipped = sum(1 for p in audit.last_programs if p.jaxpr is None)
+    return {
+        "findings": len(findings),
+        "rules": len(RULES),
+        "programs_traced": len(audit.last_programs) - skipped,
+        "programs_skipped": skipped,
+        "rules_failing": sorted({f.rule for f in findings}),
+    }
+
+
+def check_static_analysis(summary: dict) -> int:
+    """Lint gate (``--check``, PR 14): the static passes must be
+    clean — a bench number recorded over a tree that fails its own
+    invariant analysis is not a number worth recording."""
+    if not summary["findings"]:
+        print(f"bench --check lint: clean "
+              f"({summary['rules']} rule(s), "
+              f"{summary['programs_traced']} program(s) traced, "
+              f"{summary['programs_skipped']} skipped)",
+              file=sys.stderr)
+        return 0
+    print(f"bench --check lint: FAIL — {summary['findings']} "
+          f"finding(s) across rule(s) {summary['rules_failing']}; "
+          "run `make lint` for the report", file=sys.stderr)
     return 1
 
 

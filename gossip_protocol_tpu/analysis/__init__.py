@@ -21,11 +21,15 @@ this package — enforced almost nowhere:
 
 Each of these was originally found BY HAND after it cost a
 regression.  This package turns the whole bug class into machine
-checks, three passes deep:
+checks, four passes deep:
 
 * :mod:`.jaxpr_audit` — rules over ``jax.make_jaxpr`` output of the
   registered hot programs (solo tick, fleet scan, lane-mesh program,
-  grid kernel, checkpoint-leg resume);
+  2-D lanes×peers prototype, grid kernel, checkpoint-leg resume);
+* :mod:`.sharding_flow` — a dataflow pass over the same registry
+  propagating per-value mesh-axis sharding and holding every
+  collective to per-axis contracts (zero on lanes, budgeted on
+  peers, replicated plane stays replicated, specs stay derivable);
 * :mod:`.purity_lint` — repo-specific AST rules over the package
   source (wall-clock/unseeded-RNG bans in pure paths, numpy-only
   staging, no in-place writes on host views) plus the cache-key
@@ -64,7 +68,7 @@ class RuleInfo:
     """Catalog entry: what a rule protects and where it came from."""
 
     name: str
-    pass_name: str   # "jaxpr" | "ast" | "guard"
+    pass_name: str   # "jaxpr" | "sharding" | "ast" | "guard"
     protects: str
     origin: str      # the regression / PR that motivated it
 
@@ -109,6 +113,40 @@ RULES: tuple[RuleInfo, ...] = (
              "into its compile-cache/bucket key or flows through the "
              "Schedule arrays as data",
              "PR 1/3 (plan-signature cache keys; stale-program class)"),
+    RuleInfo("lanes-axis-zero-collectives", "sharding",
+             "no collective runs over a zero-collective (lane) axis "
+             "of a mesh program — the axis-aware successor of "
+             "zero-collectives-per-tick, so the 2-D lanes×peers "
+             "program can be certified at all",
+             "PR 14 (the 2-D mesh gate; PERF §10: lanes are plain "
+             "data parallelism)"),
+    RuleInfo("peers-axis-collective-budget", "sharding",
+             "the peer-axis exchange inside the scanned tick body "
+             "stays within its declared static per-eqn budget "
+             "(1 all_to_all + 3 ppermute + 1 psum for the dense "
+             "RingComm tick) — a bust is a per-tick regression",
+             "PR 14 (PERF §4's ring cost, held constant by contract)"),
+    RuleInfo("replicated-plane-stays-replicated", "sharding",
+             "clock/drop-plane values carry no mesh axis anywhere on "
+             "their def-use chain: unsharded at the shard_map "
+             "boundary, device-invariant cond predicates, no scan-"
+             "carry widening — the static generalization of the "
+             "cond-degradation twin test",
+             "PR 14 (PR 3's shared-drop rule + PR 4's mesh pin, "
+             "per-axis edition)"),
+    RuleInfo("spec-derivation-consistent", "sharding",
+             "the traced shard_map in_names match the specs derived "
+             "independently from the fleet vmap-axes trees (composed "
+             "with the peer spec trees for 2-D), failing with the "
+             "offending leaf path",
+             "PR 14 (PERF §10: 2-D specs must stay derivable, never "
+             "hand-maintained)"),
+    RuleInfo("journal-before-mutation", "ast",
+             "every code path that sets a request's terminal status "
+             "under a run_dir store is dominated by the matching "
+             "write-ahead journal append (scheduler + recovery)",
+             "PR 12 (the crash-window lesson: status visible before "
+             "its outcome record loses the request on restart)"),
     RuleInfo("no-recompile-steady-state", "guard",
              "a warmed serving/bench lap triggers zero fresh XLA "
              "compiles (compile-count budget)",
@@ -124,19 +162,24 @@ def rule_names() -> list[str]:
     return [r.name for r in RULES]
 
 
-def run_all(passes=("jaxpr", "ast"), rules=None) -> list[Finding]:
+def run_all(passes=("jaxpr", "sharding", "ast"), rules=None) -> list[Finding]:
     """Run the static passes and return every finding.
 
-    ``passes`` selects jaxpr / ast (the guard pass is runtime-shaped:
-    it runs inside bench.py --check and the tier-1 tests, not here —
-    but ``python -m gossip_protocol_tpu.analysis --pass guard`` runs
-    its self-check).  ``rules`` optionally restricts to a subset of
-    rule names.
+    ``passes`` selects jaxpr / sharding / ast (the guard pass is
+    runtime-shaped: it runs inside bench.py --check and the tier-1
+    tests, not here — but ``python -m gossip_protocol_tpu.analysis
+    --pass guard`` runs its self-check).  ``rules`` optionally
+    restricts to a subset of rule names.  The sharding pass runs
+    after jaxpr so it reuses the jaxpr pass's traced registry instead
+    of tracing it twice.
     """
     findings: list[Finding] = []
     if "jaxpr" in passes:
         from . import jaxpr_audit
         findings += jaxpr_audit.audit(rules=rules)
+    if "sharding" in passes:
+        from . import sharding_flow
+        findings += sharding_flow.check(rules=rules)
     if "ast" in passes:
         from . import purity_lint
         findings += purity_lint.lint(rules=rules)

@@ -1,21 +1,28 @@
 """CLI: ``python -m gossip_protocol_tpu.analysis``.
 
-Runs the three invariant passes over the tree and exits nonzero on
+Runs the four invariant passes over the tree and exits nonzero on
 any finding.  ``--list`` prints the rule catalog; ``--pass``/
-``--rule`` restrict the run (``make lint`` runs the two static
+``--rule`` restrict the run (``make lint`` runs the three static
 passes; the guard pass self-checks its machinery — its real
-enforcement points are ``bench.py --check`` and tier-1).
+enforcement points are ``bench.py --check`` and tier-1).  ``--json``
+emits one machine-readable document (rule, program/file:line, eqn
+path, plus the covered-program roster) for CI and
+``scripts/bench_trajectory.py`` — ``make lint-json``.
 
-The jaxpr pass traces the lane-mesh programs, which need >= 2
-devices: virtual CPU devices are forced below BEFORE jax first
-imports, mirroring tests/conftest.py and the smoke scripts.
+The jaxpr/sharding passes trace the mesh programs, which need up to
+8 devices (the 2-D lanes×peers prototype): virtual CPU devices are
+forced below BEFORE jax first imports, mirroring tests/conftest.py
+and the smoke scripts.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
+
+_STATIC_PASSES = ("jaxpr", "sharding", "ast", "guard")
 
 
 def _force_virtual_devices():
@@ -26,7 +33,10 @@ def _force_virtual_devices():
     XLA_FLAGS here cannot take effect in-process — the mesh audit
     entries would silently skip.  One guarded re-exec with the
     corrected environment fixes it; explicit user-set flags are
-    respected as-is."""
+    respected as-is.  The full ``sys.argv[1:]`` rides through the
+    re-exec, so ``--pass``/``--rule``/``--json`` survive it
+    (tests/test_analysis.py pins this), and an exec that fails exits
+    nonzero instead of silently green-lighting the caller."""
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" in flags \
             or os.environ.get("_GOSSIP_ANALYSIS_REEXEC") == "1":
@@ -35,9 +45,36 @@ def _force_virtual_devices():
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
-    os.execv(sys.executable,
-             [sys.executable, "-m", "gossip_protocol_tpu.analysis"]
-             + sys.argv[1:])
+    try:
+        os.execv(sys.executable,
+                 [sys.executable, "-m", "gossip_protocol_tpu.analysis"]
+                 + sys.argv[1:])
+    except OSError as e:
+        print(f"analysis: re-exec with virtual devices failed ({e}); "
+              "refusing to continue with the mesh entries silently "
+              "skipped", file=sys.stderr)
+        raise SystemExit(2)
+
+
+def _program_roster() -> list[dict]:
+    """The traced-program roster (covered AND skipped) from whichever
+    pass last built it — visibility into what the run actually
+    checked is part of the contract (a mesh entry skipping for want
+    of devices must never read as a pass)."""
+    from .jaxpr_audit import audit as _audit
+    from .sharding_flow import check as _scheck
+    progs = _audit.last_programs or _scheck.last_programs
+    roster = []
+    for p in progs:
+        roster.append({
+            "name": p.name,
+            "state": "skipped" if p.jaxpr is None else "traced",
+            "rules": list(p.rules),
+            "sharding_contract": getattr(p, "contract", None)
+            is not None,
+            "notes": p.notes,
+        })
+    return roster
 
 
 def main(argv=None) -> int:
@@ -45,11 +82,15 @@ def main(argv=None) -> int:
         prog="python -m gossip_protocol_tpu.analysis",
         description="static invariant analysis (docs/ANALYSIS.md)")
     ap.add_argument("--pass", dest="passes", action="append",
-                    choices=("jaxpr", "ast", "guard"),
+                    choices=_STATIC_PASSES,
                     help="run only this pass (repeatable; default: "
-                         "jaxpr + ast + guard)")
+                         "jaxpr + sharding + ast + guard)")
     ap.add_argument("--rule", action="append",
                     help="run only this rule (repeatable)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable JSON document "
+                         "(findings + covered-program roster) instead "
+                         "of the human report; exit code unchanged")
     ap.add_argument("--list", action="store_true",
                     help="print the rule catalog and exit")
     args = ap.parse_args(argv)
@@ -61,8 +102,7 @@ def main(argv=None) -> int:
             print(f"{'':32s}   origin: {r.origin}")
         return 0
 
-    passes = tuple(args.passes) if args.passes \
-        else ("jaxpr", "ast", "guard")
+    passes = tuple(args.passes) if args.passes else _STATIC_PASSES
     rules = tuple(args.rule) if args.rule else None
     if rules is not None:
         # a typo'd --rule silently checking NOTHING while exiting 0
@@ -83,15 +123,32 @@ def main(argv=None) -> int:
     active = [r.name for r in RULES
               if r.pass_name in set(passes)
               and (rules is None or r.name in rules)]
+    traces = {"jaxpr", "sharding"} & set(passes)
+
+    if args.json:
+        payload = {
+            "ok": not findings,
+            "passes": list(passes),
+            "rules": active,
+            "programs": _program_roster() if traces else [],
+            "findings": [{"rule": f.rule, "where": f.where,
+                          "detail": f.detail, "path": f.path}
+                         for f in findings],
+            "count": len(findings),
+        }
+        print(json.dumps(payload, indent=1))
+        return 1 if findings else 0
+
     print(f"analysis: {len(active)} rule(s) over passes "
           f"{'+'.join(passes)}: {', '.join(active)}")
-    if "jaxpr" in passes:
-        from .jaxpr_audit import audit as _audit
-        for p in _audit.last_programs:
-            state = "skipped" if p.jaxpr is None else \
-                f"{len(p.rules)} rule(s)"
-            note = f"  ({p.notes})" if p.notes else ""
-            print(f"  program {p.name}: {state}{note}")
+    if traces:
+        for p in _program_roster():
+            state = "skipped" if p["state"] == "skipped" else \
+                f"{len(p['rules'])} rule(s)" \
+                + (" + sharding contract"
+                   if p["sharding_contract"] else "")
+            note = f"  ({p['notes']})" if p["notes"] else ""
+            print(f"  program {p['name']}: {state}{note}")
     if findings:
         print(f"\n{len(findings)} finding(s):\n", file=sys.stderr)
         for f in findings:

@@ -31,11 +31,13 @@ jaxprs, and Pallas kernel jaxprs) and checked against named rules:
 
 ``donation-taken``
     Programs built with a donated scan carry (``donate_argnums``)
-    must actually alias that input to an output — the
-    ``tf.aliasing_output`` marker in the single-device MLIR, or
-    ``input_output_alias`` in the compiled executable for the
-    sharded path (shard_map plumbs donation at compile time with no
-    MLIR marker; verified on jax 0.4.37).  A donation that quietly
+    must actually alias that input to an output — primary evidence is
+    ``input_output_alias`` in the compiled executable, which both the
+    single-device and sharded paths carry (shard_map plumbs donation
+    at compile time with no MLIR marker; verified on jax 0.4.37 +
+    XLA:CPU), with the jax-version-fragile MLIR
+    ``tf.aliasing_output`` marker demoted to fallback.  A donation
+    that quietly
     stops lowering (a dtype change, a broken alias) doubles the
     resident state and — worse — changes the deletion semantics the
     PendingFleet donation-hold protocol depends on (PERF §11).
@@ -144,14 +146,15 @@ class AuditedProgram:
     twin: object = None
     min_cond: int = 0
     #: ``jax.stages.Lowered`` of the program when it declares a
-    #: donated carry (None otherwise).  The rule reads the pre-compile
-    #: MLIR first (single-device donation lowers as tf.aliasing_output
-    #: arg attrs) and falls back to compiling and reading the
-    #: executable's input_output_alias — the sharded path plumbs
-    #: donation at compile time, not in the MLIR (verified on jax
-    #: 0.4.37: shard_map carries alias buffers at runtime with no
-    #: MLIR marker).
+    #: donated carry (None otherwise).  The rule compiles it and reads
+    #: the executable's ``input_output_alias`` — the authoritative
+    #: record on every path (single-device AND shard_map; verified on
+    #: jax 0.4.37 + XLA:CPU) — keeping the pre-compile MLIR
+    #: ``tf.aliasing_output`` marker only as a version-drift fallback.
     lowered: object = None
+    #: :class:`..sharding_flow.ShardingContract` for mesh programs the
+    #: sharding-flow pass certifies (None = pass skips the program).
+    contract: object = None
     notes: str = ""
 
 
@@ -205,8 +208,9 @@ def build_programs(mesh_devices: int = 2) -> list[AuditedProgram]:
 
     Covers the acceptance surface: solo tick (dense + overlay), fleet
     scan (dense shared-vs-batched twin + overlay), the D=2 lane-mesh
-    ``shard_map`` program (dense twin pair + overlay), the grid
-    kernel, and the checkpoint-leg resume program.
+    ``shard_map`` program (dense twin pair + overlay), the 2-D
+    lanes×peers prototype (2×4 devices, sharding-contract-carrying),
+    the grid kernel, and the checkpoint-leg resume program.
     """
     import jax
 
@@ -305,6 +309,17 @@ def build_programs(mesh_devices: int = 2) -> list[AuditedProgram]:
 
     # ---- lane-mesh programs (D=2) ----------------------------------
     import jax as _jax
+
+    from ..core.fleet import SCHED_AXES_SHARED_DROP, WORLD_AXES
+    from ..models.overlay import (OVERLAY_FLEET_STATE_AXES,
+                                  OverlaySchedule)
+    # sharding_flow imports this module; import lazily to break the
+    # cycle.  Each mesh entry's contract carries independently derived
+    # expected in_names so spec-derivation-consistent can cross-check
+    # the builders' own spec derivation.
+    from .sharding_flow import (ShardingContract, all_batched_dims,
+                                axes_tree_dims)
+
     if _jax.device_count() >= mesh_devices:
         from ..parallel.fleet_mesh import (MeshFleetSimulation,
                                            make_lane_mesh)
@@ -315,10 +330,17 @@ def build_programs(mesh_devices: int = 2) -> list[AuditedProgram]:
         mtwin = jax.make_jaxpr(ms._dense_bench_fn(2, dcfg.n, False)
                                .jitted)(*dargs_b)
         mlow = mrun.jitted.lower(*dargs)
+        mdims = (axes_tree_dims("state", WORLD_AXES)
+                 + axes_tree_dims("sched", SCHED_AXES_SHARED_DROP))
         progs.append(AuditedProgram(
             name=f"mesh-dense-bench-d{mesh_devices}",
             provenance=_provenance(MeshFleetSimulation._dense_bench_fn),
             jaxpr=mjx, twin=mtwin, min_cond=1, lowered=mlow,
+            contract=ShardingContract(
+                mesh_axes=("lanes",),
+                zero_collective_axes=("lanes",),
+                replicated_plane=tuple(n for n, d in mdims if not d),
+                expected_in_names=mdims),
             rules=("cond-stays-cond", "zero-collectives-per-tick",
                    "donation-taken", "no-transfer-in-scan")))
 
@@ -326,17 +348,71 @@ def build_programs(mesh_devices: int = 2) -> list[AuditedProgram]:
         morun = mos._overlay_fleet_fn(2)
         mojx = jax.make_jaxpr(morun.jitted)(*ofargs)
         molow = morun.jitted.lower(*ofargs)
+        modims = (axes_tree_dims("state", OVERLAY_FLEET_STATE_AXES)
+                  + all_batched_dims("sched", OverlaySchedule))
         progs.append(AuditedProgram(
             name=f"mesh-overlay-d{mesh_devices}",
             provenance=_provenance(
                 MeshFleetSimulation._overlay_fleet_fn),
             jaxpr=mojx, min_cond=1, lowered=molow,
+            contract=ShardingContract(
+                mesh_axes=("lanes",),
+                zero_collective_axes=("lanes",),
+                replicated_plane=tuple(n for n, d in modims if not d),
+                expected_in_names=modims),
             rules=("cond-stays-cond", "zero-collectives-per-tick",
                    "donation-taken", "no-transfer-in-scan")))
     else:
         progs.append(AuditedProgram(
             name=f"mesh-(skipped: {_jax.device_count()} device(s) "
                  f"live, need {mesh_devices})",
+            provenance="parallel/fleet_mesh.py", jaxpr=None, rules=(),
+            notes="force virtual devices: XLA_FLAGS="
+                  "--xla_force_host_platform_device_count=8 before "
+                  "jax imports (python -m gossip_protocol_tpu."
+                  "analysis does this itself)"))
+
+    # ---- 2-D lanes x peers prototype (2 x 4 = 8 devices) -----------
+    # The flagship sharding-flow entry: the ROADMAP's 2-D mesh,
+    # registered BEFORE the serving wiring lands so the per-axis
+    # rules gate that PR (ISSUE 14).  zero-collectives-per-tick is
+    # deliberately NOT on this program — its peer axis legitimately
+    # collects every tick; the axis-aware contract replaces it.
+    n2_lanes, n2_peers = 2, 4
+    if _jax.device_count() >= n2_lanes * n2_peers:
+        from ..parallel.fleet_mesh import (
+            LANE_PEER_TICK_COLLECTIVE_BUDGET, make_lane_peer_bench_fn,
+            make_lane_peer_mesh)
+        from ..parallel.sharded import PEER_AXIS, peer_spec_trees
+        mesh2 = make_lane_peer_mesh(n2_lanes, n2_peers)
+        prun = make_lane_peer_bench_fn(dcfg, mesh2)
+        pjx = jax.make_jaxpr(prun)(*dargs)
+        plow = prun.lower(*dargs)
+        peer_state, peer_sched = peer_spec_trees(PEER_AXIS)
+        pdims = (axes_tree_dims("state", WORLD_AXES,
+                                peer_specs=peer_state)
+                 + axes_tree_dims("sched", SCHED_AXES_SHARED_DROP,
+                                  peer_specs=peer_sched))
+        progs.append(AuditedProgram(
+            name="mesh2d-lanes-peers",
+            provenance=_provenance(make_lane_peer_bench_fn),
+            jaxpr=pjx, min_cond=1, lowered=plow,
+            contract=ShardingContract(
+                mesh_axes=("lanes", PEER_AXIS),
+                zero_collective_axes=("lanes",),
+                budgets={PEER_AXIS: LANE_PEER_TICK_COLLECTIVE_BUDGET},
+                replicated_plane=tuple(n for n, d in pdims if not d),
+                expected_in_names=pdims),
+            rules=("cond-stays-cond", "donation-taken",
+                   "no-transfer-in-scan"),
+            notes=f"{n2_lanes} lanes x {n2_peers} peers on virtual "
+                  "CPU devices (the ROADMAP 2-D prototype; "
+                  "bit-identical to the 1-D fleet — "
+                  "tests/test_fleet_mesh.py)"))
+    else:
+        progs.append(AuditedProgram(
+            name=f"mesh2d-(skipped: {_jax.device_count()} device(s) "
+                 f"live, need {n2_lanes * n2_peers})",
             provenance="parallel/fleet_mesh.py", jaxpr=None, rules=(),
             notes="force virtual devices: XLA_FLAGS="
                   "--xla_force_host_platform_device_count=8 before "
@@ -386,13 +462,15 @@ def check_zero_collectives(prog: AuditedProgram) -> list[Finding]:
 def check_donation_taken(prog: AuditedProgram) -> list[Finding]:
     if prog.lowered is None:
         return []
-    # single-device donation shows as tf.aliasing_output arg attrs in
-    # the MLIR; the SHARDED path (shard_map under jit) plumbs it at
-    # compile time instead, so fall back to the executable's
-    # input_output_alias (the authoritative record either way)
-    if "tf.aliasing_output" in prog.lowered.as_text():
-        return []
+    # the compiled executable's input_output_alias is the primary
+    # evidence on EVERY path: single-device donation carries it too,
+    # and the sharded path (shard_map under jit) carries ONLY it —
+    # donation there is plumbed at compile time with no MLIR marker.
+    # The MLIR tf.aliasing_output arg attr is a TF-flavored spelling
+    # that jax versions have moved around; keep it as fallback only.
     if "input_output_alias" in prog.lowered.compile().as_text():
+        return []
+    if "tf.aliasing_output" in prog.lowered.as_text():
         return []
     return [Finding(
         "donation-taken", prog.name,

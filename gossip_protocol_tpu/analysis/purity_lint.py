@@ -32,6 +32,17 @@ they live in HOST python, not in traced programs:
     aliasing converter (``np.asarray(lane.metrics.sent)``,
     ``.view()``, ``.reshape()``) — are flagged.
 
+``journal-before-mutation``
+    In the durable-serving modules (service/scheduler.py,
+    store/recovery.py), every code path that sets a request's
+    terminal status (``._complete()`` / ``._fail()``) must be
+    textually dominated, within its function, by the matching
+    write-ahead ``journal.outcome(...)`` append.  This is PR 12's
+    crash-window lesson as a machine check: a terminal status that
+    becomes visible to callers BEFORE its outcome record hits the
+    journal means a crash in that window re-runs (or loses) the
+    request on recovery (docs/SERVING.md).
+
 Findings can be allowlisted in ``analysis/lint_allow.toml`` — every
 entry must carry a ``why`` (the file is the audit trail; an
 uncommented entry is itself a lint error).
@@ -106,6 +117,19 @@ HOST_VIEW_MODULES = (
     "gossip_protocol_tpu/store/recovery.py",
     "gossip_protocol_tpu/store/harness.py",
 )
+
+#: modules whose terminal-status writers must journal FIRST
+#: (recovery.py currently sets no terminal status — it readmits —
+#: but stays covered so a future direct setter there is caught)
+JOURNAL_ORDER_MODULES = (
+    "gossip_protocol_tpu/service/scheduler.py",
+    "gossip_protocol_tpu/store/recovery.py",
+)
+
+#: the handle methods that make a request's terminal status visible
+#: to callers (service/scheduler.py RequestHandle)
+_TERMINAL_SETTERS = frozenset({"_complete", "_fail"})
+
 
 #: converters that can ALIAS their argument (a write through the
 #: result can mutate the argument's buffer)
@@ -404,6 +428,70 @@ def _check_host_views(tree, lines, relfile, allow) -> list[Finding]:
     return out
 
 
+# ---- rule: journal-before-mutation -----------------------------------
+def _walk_local(fn):
+    """Walk a function's OWN statements, not those of nested defs —
+    a setter inside a nested function must be judged against that
+    function's journal appends, not the enclosing one's."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _check_journal_order(tree, lines, relfile, allow) -> list[Finding]:
+    out = []
+    fns = [n for n in ast.walk(tree)
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    # a def nested inside another def is a DEFERRED body — textual
+    # domination is meaningless there (the journal append lives at
+    # the call site), so the rule only judges top-level fns/methods
+    nested = {inner for fn in fns for inner in ast.walk(fn)
+              if inner is not fn
+              and isinstance(inner, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+    for fn in fns:
+        if fn in nested:
+            continue
+        appends = []   # linenos of journal.outcome(...) appends
+        setters = []   # (node, chain) of terminal-status calls
+        for node in _walk_local(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if not chain:
+                continue
+            if chain[-1] == "outcome" and "journal" in chain[:-1]:
+                appends.append(node.lineno)
+            elif chain[-1] in _TERMINAL_SETTERS:
+                setters.append((node, chain))
+        for node, chain in setters:
+            # textual domination within the function: the append must
+            # come first on the path (same-line counts — the append
+            # guard wraps the setter's own statement in practice)
+            if any(jl <= node.lineno for jl in appends):
+                continue
+            line = lines[node.lineno - 1] \
+                if node.lineno <= len(lines) else ""
+            if _allowed(allow, "journal-before-mutation", relfile,
+                        line):
+                continue
+            out.append(Finding(
+                "journal-before-mutation",
+                f"{relfile}:{node.lineno}",
+                f".{chain[-1]}() makes a terminal status visible in "
+                f"{fn.name}() with no preceding journal.outcome() "
+                "append — a crash between the two re-runs (or loses) "
+                "the request on recovery (the PR-12 crash window, "
+                "docs/SERVING.md)",
+                path=fn.name))
+    return out
+
+
 # ---- driver ----------------------------------------------------------
 def lint(rules=None) -> list[Finding]:
     allow, findings = load_allowlist()
@@ -424,6 +512,10 @@ def lint(rules=None) -> list[Finding]:
         for rel in HOST_VIEW_MODULES:
             tree, lines = _read_lines(os.path.join(REPO_ROOT, rel))
             findings += _check_host_views(tree, lines, rel, allow)
+    if want("journal-before-mutation"):
+        for rel in JOURNAL_ORDER_MODULES:
+            tree, lines = _read_lines(os.path.join(REPO_ROOT, rel))
+            findings += _check_journal_order(tree, lines, rel, allow)
     return findings
 
 
@@ -441,6 +533,8 @@ def raw_findings(rule: str, relfile: str) -> list[Finding]:
             [])
     if rule == "no-inplace-on-host-views":
         return _check_host_views(tree, lines, relfile, [])
+    if rule == "journal-before-mutation":
+        return _check_journal_order(tree, lines, relfile, [])
     raise ValueError(f"unknown AST rule {rule!r}")
 
 
@@ -460,4 +554,6 @@ def lint_source(src: str, relfile: str = "<fixture>.py",
                                    tuple(staging_funcs), [])
     if rule == "no-inplace-on-host-views":
         return _check_host_views(tree, lines, relfile, [])
+    if rule == "journal-before-mutation":
+        return _check_journal_order(tree, lines, relfile, [])
     raise ValueError(f"unknown AST rule {rule!r}")

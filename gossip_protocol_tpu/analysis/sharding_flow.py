@@ -1,0 +1,470 @@
+"""Sharding-flow pass: per-axis collective rules over mesh programs.
+
+The jaxpr audit's ``zero-collectives-per-tick`` is axis-blind: it bans
+EVERY collective, which is correct for the 1-D lane mesh (lanes are
+plain data parallelism, PERF §10) but *wrong* for the peer axis — the
+XOR-exchange ``all_to_all``/``ppermute`` ring there is the whole point
+(PERF §4).  The 2-D ``Mesh((lanes, peers))`` composition the ROADMAP
+names as the biggest unclaimed scale unlock therefore cannot be
+certified by the old rule at all.  This pass grows the axis awareness:
+
+A small abstract interpreter propagates, for every value inside a
+``shard_map`` body, the set of mesh axis names the value is
+*device-varying* over — seeded from the traced ``in_names``, joined
+through each equation, removed by cross-axis reductions
+(``psum``/``all_gather``), introduced by ``axis_index``, and carried
+to fixpoint through ``scan``/``while`` bodies and ``cond`` branches.
+Every collective equation is attributed to the concrete axis name in
+its params.  Four rules consume the walk (each registered program
+declares a :class:`ShardingContract`):
+
+``lanes-axis-zero-collectives``
+    No collective may name a zero-collective axis (the lane axis).
+    The old rule, scoped per axis: the 2-D program's peer collectives
+    pass, a collective smuggled onto ``lanes`` fires.
+
+``peers-axis-collective-budget``
+    The sharded exchange inside the scanned tick body carries a
+    declared STATIC per-tick equation budget per axis (the dense
+    RingComm tick: 1 ``all_to_all`` + 3 ``ppermute`` + 1 ``psum``).
+    A bust means a per-tick regression — a collective added to the
+    hot loop — not a one-off; collectives over an axis with no
+    declared budget fire unconditionally.
+
+``replicated-plane-stays-replicated``
+    The clock/drop-plane leaves must enter the shard_map with NO mesh
+    axis (their ``in_names`` entry is empty), every ``cond`` predicate
+    inside the body must be device-invariant (a varying predicate
+    means the shared window cond diverges per device — the static
+    generalization of the cond-degradation twin test), and a scan
+    carry slot that enters device-invariant must exit that way (the
+    clock's def-use chain across ticks).
+
+``spec-derivation-consistent``
+    The traced ``in_names`` must equal the dims derived independently
+    from the fleet's vmap-axes trees (composed with the peer-axis
+    spec trees for the 2-D program) — failing with the offending leaf
+    path.  Closed-over inputs hoisted ahead of the arg tree must be
+    replicated.
+
+Run: ``python -m gossip_protocol_tpu.analysis --pass sharding`` (the
+CLI forces 8 virtual CPU devices so the 2-D prototype traces on a
+bare box).  Catalog: docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+
+from . import Finding
+from .jaxpr_audit import COLLECTIVE_PRIMS, iter_eqns
+
+#: collectives whose RESULT is device-invariant over the named axes
+#: (a cross-axis reduction/gather); everything else — ppermute,
+#: all_to_all, pgather, reduce_scatter — keeps (or adds) the axis
+_REDUCING_PRIMS = frozenset({
+    "psum", "psum2", "pmax", "pmin", "pmean",
+    "all_gather", "all_gather_invariant",
+})
+
+
+# ---- the per-program contract ----------------------------------------
+@dataclass(frozen=True)
+class ShardingContract:
+    """What a registered mesh program promises about its axes.
+
+    ``expected_in_names`` is the independently derived flat spec
+    list: one ``(leaf_path, {dim: (axis, ...)})`` per flattened arg
+    leaf, aligned with the TAIL of the shard_map's ``in_names``
+    (tracing may hoist closed-over constants ahead of the args —
+    those must be replicated)."""
+
+    mesh_axes: tuple
+    zero_collective_axes: tuple = ("lanes",)
+    #: axis -> max STATIC collective eqns inside the scanned tick body
+    budgets: dict = field(default_factory=dict)
+    #: leaf paths (``state.tick``, ``sched.drop_active``, ...) that
+    #: must stay device-invariant end to end
+    replicated_plane: tuple = ()
+    expected_in_names: tuple = ()
+
+
+# ---- contract derivation helpers (registry side) ---------------------
+def spec_to_dims(spec) -> dict:
+    """PartitionSpec -> ``{dim: (axis, ...)}`` (None entries elided)."""
+    out = {}
+    for i, part in enumerate(spec):
+        if part is None:
+            continue
+        out[i] = (part,) if isinstance(part, str) else tuple(part)
+    return out
+
+
+def axes_tree_dims(prefix: str, axes_tree, lane_axis: str = "lanes",
+                   peer_specs=None) -> tuple:
+    """Derive the expected per-leaf ``in_names`` dims from a vmap axes
+    tree, optionally composed with a peer-axis PartitionSpec tree.
+
+    This mirrors — *independently of* — the builders' own spec
+    derivation (``fleet_mesh._axes_to_specs`` for 1-D,
+    ``fleet_mesh.compose_lane_peer_specs`` for 2-D): a lane-batched
+    leaf is lane-sharded on its new leading dim (shifting any peer
+    dims right by one); an unbatched leaf (the clock, the shared drop
+    plane) carries only its peer dims — none, for the replicated
+    plane.  If a builder's derivation drifts from this one, the
+    ``spec-derivation-consistent`` rule fires with the leaf path."""
+    entries = []
+    for f in dataclasses.fields(type(axes_tree)):
+        batched = getattr(axes_tree, f.name) is not None
+        pd = spec_to_dims(getattr(peer_specs, f.name)) \
+            if peer_specs is not None else {}
+        if batched:
+            d = {0: (lane_axis,)}
+            d.update({k + 1: v for k, v in pd.items()})
+        else:
+            d = pd
+        entries.append((f"{prefix}.{f.name}", d))
+    return tuple(entries)
+
+
+def all_batched_dims(prefix: str, cls, lane_axis: str = "lanes") -> tuple:
+    """Every field of ``cls`` lane-sharded on its leading dim (the
+    overlay mesh schedule: vmap ``in_axes=0`` across the board)."""
+    return tuple((f"{prefix}.{f.name}", {0: (lane_axis,)})
+                 for f in dataclasses.fields(cls))
+
+
+# ---- the abstract interpreter ----------------------------------------
+class _Trace:
+    """Everything one body walk collects for the rules."""
+
+    def __init__(self):
+        self.collectives = []   # (path_str, prim_name, axes tuple)
+        self.cond_preds = []    # (path_str, axes frozenset)
+        self.widened = []       # (path_str, slot, aval str, axes)
+
+
+def collective_axes(eqn) -> tuple:
+    """The mesh axis names a collective eqn runs over, normalized
+    across the primitives' inconsistent param spellings (``ppermute``:
+    ``axis_name=('peers',)``; ``all_to_all``: ``axis_name='peers'``;
+    ``psum``: ``axes=('peers',)`` — verified on jax 0.4.37).
+    Positional (integer) axes are not mesh axes and are elided."""
+    raw = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    if isinstance(raw, str):
+        raw = (raw,)
+    return tuple(a for a in raw if isinstance(a, str))
+
+
+def _flow(jaxpr, in_sets, path, trace):
+    """Propagate device-varying axis-sets through one jaxpr.
+
+    Returns the outvars' axis-sets.  ``trace=None`` mutes reporting
+    (fixpoint iterations walk bodies repeatedly; only the final
+    post-fixpoint pass records collectives/predicates)."""
+    env = {}
+
+    def get(atom):
+        # Literals carry .val and are device-invariant by definition
+        return frozenset() if hasattr(atom, "val") \
+            else env.get(atom, frozenset())
+
+    for v, s in zip(jaxpr.invars, in_sets):
+        env[v] = s
+    for v in jaxpr.constvars:
+        env[v] = frozenset()
+
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        ins = [get(a) for a in eqn.invars]
+        joined = frozenset().union(*ins) if ins else frozenset()
+        outs = None
+
+        if name in COLLECTIVE_PRIMS:
+            axes = collective_axes(eqn)
+            if trace is not None:
+                trace.collectives.append(
+                    ("/".join(path) or "<top>", name, axes))
+            if name in _REDUCING_PRIMS:
+                res = joined - set(axes)
+            else:
+                res = joined | set(axes)
+            outs = [res] * len(eqn.outvars)
+
+        elif name == "axis_index":
+            # introduces device variation from thin air
+            outs = [frozenset(collective_axes(eqn))] * len(eqn.outvars)
+
+        elif name == "scan":
+            nc, nk = eqn.params["num_consts"], eqn.params["num_carry"]
+            body = eqn.params["jaxpr"].jaxpr
+            consts, carry, xs = ins[:nc], ins[nc:nc + nk], ins[nc + nk:]
+            entry = list(carry)
+            sub = path + ("scan.jaxpr",)
+            for _ in range(len(carry) + 1):
+                res = _flow(body, consts + carry + xs, sub, None)
+                new = [c | r for c, r in zip(carry, res[:nk])]
+                if new == carry:
+                    break
+                carry = new
+            res = _flow(body, consts + carry + xs, sub, trace)
+            carry = [c | r for c, r in zip(carry, res[:nk])]
+            if trace is not None:
+                for i, (before, after) in enumerate(zip(entry, carry)):
+                    if not before and after:
+                        trace.widened.append(
+                            ("/".join(sub), i,
+                             str(eqn.invars[nc + i].aval), after))
+            outs = carry + res[nk:]
+
+        elif name == "while":
+            cn = eqn.params["cond_nconsts"]
+            bn = eqn.params["body_nconsts"]
+            cc, bc, carry = ins[:cn], ins[cn:cn + bn], ins[cn + bn:]
+            body = eqn.params["body_jaxpr"].jaxpr
+            sub = path + ("while.body_jaxpr",)
+            for _ in range(len(carry) + 1):
+                res = _flow(body, bc + carry, sub, None)
+                new = [c | r for c, r in zip(carry, res)]
+                if new == carry:
+                    break
+                carry = new
+            res = _flow(body, bc + carry, sub, trace)
+            carry = [c | r for c, r in zip(carry, res)]
+            # the loop condition can hide a collective too
+            _flow(eqn.params["cond_jaxpr"].jaxpr, cc + carry,
+                  path + ("while.cond_jaxpr",), trace)
+            outs = carry
+
+        elif name == "cond":
+            pred, ops = ins[0], ins[1:]
+            if trace is not None:
+                trace.cond_preds.append(("/".join(path) or "<top>",
+                                         pred))
+            branch_outs = None
+            for br in eqn.params["branches"]:
+                res = _flow(br.jaxpr, ops, path + ("cond.branches",),
+                            trace)
+                branch_outs = res if branch_outs is None \
+                    else [a | b for a, b in zip(branch_outs, res)]
+            # outputs data-depend on the predicate as well
+            outs = [o | pred for o in branch_outs]
+
+        else:
+            # generic call-like eqns (pjit, closed_call, custom_jvp/
+            # vjp, remat) recurse when the inner arity matches; any
+            # other eqn joins conservatively (sound upper bound —
+            # only the explicit reductions above REMOVE an axis)
+            inner = None
+            for k in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+                v = eqn.params.get(k)
+                if v is None:
+                    continue
+                j = v.jaxpr if hasattr(v, "jaxpr") else v
+                if hasattr(j, "invars") \
+                        and len(j.invars) == len(eqn.invars):
+                    inner = (k, j)
+                    break
+            if inner is not None:
+                outs = _flow(inner[1], ins,
+                             path + (f"{name}.{inner[0]}",), trace)
+            else:
+                outs = [joined] * len(eqn.outvars)
+
+        for v, s in zip(eqn.outvars, outs):
+            env[v] = s
+    return [get(v) for v in jaxpr.outvars]
+
+
+# ---- shard_map introspection -----------------------------------------
+def _eqn_in_dims(eqn) -> list:
+    """Normalized per-invar ``{dim: (axis, ...)}`` of a shard_map eqn
+    (0.4.x spells it ``in_names`` as tuple-of-dicts; newer jax may
+    carry PartitionSpecs under ``in_specs``)."""
+    if "in_names" in eqn.params:
+        return [{int(k): tuple(v) for k, v in d.items()}
+                for d in eqn.params["in_names"]]
+    return [spec_to_dims(s) for s in eqn.params["in_specs"]]
+
+
+def _shard_map_eqns(closed_jaxpr):
+    return [(p, e) for p, e in iter_eqns(closed_jaxpr.jaxpr)
+            if e.primitive.name == "shard_map"]
+
+
+# ---- the rules --------------------------------------------------------
+def check_program(prog, rules=None) -> list[Finding]:
+    """All four sharding rules over one contract-carrying program."""
+    c = getattr(prog, "contract", None)
+    if c is None or prog.jaxpr is None:
+        return []
+
+    def want(r):
+        return rules is None or r in rules
+
+    out: list[Finding] = []
+    sms = _shard_map_eqns(prog.jaxpr)
+    if not sms:
+        out.append(Finding(
+            "spec-derivation-consistent", prog.name,
+            "program declares a sharding contract but lowers no "
+            "shard_map equation — the mesh program stopped being a "
+            "mesh program",
+            path=prog.provenance))
+        return out
+
+    for path, eqn in sms:
+        pstr = "/".join(path) or "<top>"
+        names = _eqn_in_dims(eqn)
+        inner = eqn.params["jaxpr"]
+        inner = inner.jaxpr if hasattr(inner, "jaxpr") else inner
+
+        # ---- spec derivation + alignment --------------------------
+        exp = c.expected_in_names
+        aligned = None
+        if exp and len(names) < len(exp):
+            if want("spec-derivation-consistent"):
+                out.append(Finding(
+                    "spec-derivation-consistent", prog.name,
+                    f"shard_map takes {len(names)} inputs but the "
+                    f"spec tree derived from the vmap-axes trees has "
+                    f"{len(exp)} leaves — the in tree no longer "
+                    "matches the derivation",
+                    path=pstr))
+        elif exp:
+            lead = names[:len(names) - len(exp)]
+            aligned = names[len(names) - len(exp):]
+            if want("spec-derivation-consistent"):
+                for i, d in enumerate(lead):
+                    if d:
+                        out.append(Finding(
+                            "spec-derivation-consistent", prog.name,
+                            f"closed-over input {i} enters the "
+                            f"shard_map sharded {d} — hoisted "
+                            "constants must be replicated",
+                            path=pstr))
+                for (leaf, want_d), got in zip(exp, aligned):
+                    if got != want_d:
+                        out.append(Finding(
+                            "spec-derivation-consistent", prog.name,
+                            f"leaf {leaf}: traced in_names {got} != "
+                            f"{want_d} derived from the vmap-axes "
+                            "trees (compose_lane_peer_specs / "
+                            "_axes_to_specs drifted from the axes "
+                            "trees)",
+                            path=pstr))
+
+        # ---- replicated plane: the declared leaves enter unsharded
+        if want("replicated-plane-stays-replicated") \
+                and aligned is not None:
+            for (leaf, _), got in zip(exp, aligned):
+                if leaf in c.replicated_plane and got:
+                    axes = sorted({a for v in got.values() for a in v})
+                    out.append(Finding(
+                        "replicated-plane-stays-replicated", prog.name,
+                        f"replicated-plane leaf {leaf} enters the "
+                        f"shard_map sharded over {axes} — the shared "
+                        "clock/drop plane must be device-invariant "
+                        "(the PR-3 shared-drop rule, mesh edition)",
+                        path=pstr))
+
+        # ---- the dataflow walk ------------------------------------
+        seeds = [frozenset(a for axs in d.values() for a in axs)
+                 for d in names]
+        tr = _Trace()
+        _flow(inner, seeds, path + ("shard_map.jaxpr",), tr)
+
+        if want("lanes-axis-zero-collectives"):
+            for p, prim, axes in tr.collectives:
+                bad = sorted(set(axes) & set(c.zero_collective_axes))
+                if bad:
+                    out.append(Finding(
+                        "lanes-axis-zero-collectives", prog.name,
+                        f"collective {prim!r} runs over zero-"
+                        f"collective ax(es) {bad} — the lane axis is "
+                        "plain data parallelism and must move zero "
+                        "bytes (PERF §10)",
+                        path=p))
+
+        if want("peers-axis-collective-budget"):
+            counts: dict = {}
+            for p, prim, axes in tr.collectives:
+                for a in axes:
+                    if a in c.zero_collective_axes:
+                        continue   # already the lanes rule's finding
+                    if a not in c.budgets:
+                        out.append(Finding(
+                            "peers-axis-collective-budget", prog.name,
+                            f"collective {prim!r} over axis {a!r} "
+                            "which has no declared per-tick budget — "
+                            "declare one in the program's "
+                            "ShardingContract or drop the collective",
+                            path=p))
+                    elif any(seg.startswith("scan")
+                             for seg in p.split("/")):
+                        counts[a] = counts.get(a, 0) + 1
+            for a, budget in c.budgets.items():
+                got = counts.get(a, 0)
+                if got > budget:
+                    out.append(Finding(
+                        "peers-axis-collective-budget", prog.name,
+                        f"{got} static collective eqn(s) over axis "
+                        f"{a!r} inside the scanned tick body exceed "
+                        f"the declared per-tick budget of {budget} — "
+                        "a collective joined the hot loop (every "
+                        "tick now pays it)",
+                        path=pstr))
+
+        if want("replicated-plane-stays-replicated"):
+            for p, pred in tr.cond_preds:
+                if pred:
+                    out.append(Finding(
+                        "replicated-plane-stays-replicated", prog.name,
+                        "cond predicate is device-varying over "
+                        f"{sorted(pred)} — the window cond no longer "
+                        "runs as ONE shared branch decision across "
+                        "the mesh (the cond-degradation bug class, "
+                        "sharded edition; PERF §8/§10)",
+                        path=p))
+            for p, slot, aval, axes in tr.widened:
+                out.append(Finding(
+                    "replicated-plane-stays-replicated", prog.name,
+                    f"scan carry slot {slot} ({aval}) enters device-"
+                    f"invariant but exits varying over {sorted(axes)} "
+                    "— a replicated-plane value picked up a mesh axis "
+                    "on its def-use chain across ticks",
+                    path=p))
+    return out
+
+
+# ---- driver -----------------------------------------------------------
+SHARDING_RULES = ("lanes-axis-zero-collectives",
+                  "peers-axis-collective-budget",
+                  "replicated-plane-stays-replicated",
+                  "spec-derivation-consistent")
+
+
+def check(rules=None, mesh_devices: int = 2,
+          programs=None) -> list[Finding]:
+    """Run the sharding rules over every contract-carrying registered
+    program.  Reuses the jaxpr pass's traced roster when it already
+    ran this process (``run_all`` orders jaxpr first — tracing the
+    registry twice would double the audit's cost); builds it
+    otherwise.  The roster is kept on ``check.last_programs`` for the
+    CLI's coverage print."""
+    if rules is not None and not set(rules) & set(SHARDING_RULES):
+        check.last_programs = []
+        return []
+    if programs is None:
+        from . import jaxpr_audit
+        programs = jaxpr_audit.audit.last_programs \
+            or jaxpr_audit.build_programs(mesh_devices)
+    check.last_programs = programs
+    findings: list[Finding] = []
+    for p in programs:
+        findings += check_program(p, rules=rules)
+    return findings
+
+
+check.last_programs = []

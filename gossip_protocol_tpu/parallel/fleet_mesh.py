@@ -51,8 +51,13 @@ device-count change can never be served a stale program.
 On a TPU pod this lane mesh composes with §4's peer sharding as a
 2-D mesh (lanes × peers): the per-tick collectives stay *within* each
 lane's peer-axis submesh, and the lane axis still moves zero bytes.
-The 2-D path is documented (PERF §10), not shipped — there is no
-hardware here to validate it on.
+A working prototype of that composition ships below
+(:func:`make_lane_peer_mesh` / :func:`make_lane_peer_bench_fn`,
+validated bit-for-bit against the 1-D fleet on 8 virtual CPU devices
+and registered with the static analyzer as ``mesh2d-lanes-peers`` —
+analysis/sharding_flow.py gates its per-axis collective contract);
+the serving wiring and hardware validation remain PERF §10 /
+ROADMAP work.
 """
 
 from __future__ import annotations
@@ -160,6 +165,107 @@ def _axes_to_specs(axes):
 def _all_lane_specs(cls):
     """Every field of ``cls`` lane-sharded on its leading axis."""
     return cls(**{f.name: P(LANE_AXIS) for f in dataclasses.fields(cls)})
+
+
+# ---- the 2-D lanes x peers composition (PERF §10 prototype) ----------
+#: static collective equations per traced dense tick on the peer axis:
+#: RingComm.merge_reduce's fori_loop body carries 3 ppermutes (known /
+#: heartbeat / timestamp rings), the XOR exchange is 1 all_to_all, and
+#: the membership vote is 1 psum.  The sharding-flow auditor holds the
+#: registered 2-D program to this budget — a bust means a collective
+#: joined the per-tick hot loop (analysis/sharding_flow.py).
+LANE_PEER_TICK_COLLECTIVE_BUDGET = 5
+
+
+def make_lane_peer_mesh(n_lanes: int, n_peers: int) -> Mesh:
+    """2-D ``Mesh((lanes, peers))``: the lane mesh composed with the
+    peer-sharding axis of parallel/sharded.py."""
+    from .sharded import PEER_AXIS
+    devs = jax.devices()
+    need = n_lanes * n_peers
+    if need > len(devs):
+        raise ValueError(
+            f"asked for a {n_lanes}x{n_peers} lanes x peers mesh but "
+            f"only {len(devs)} devices are available "
+            f"(backend={jax.default_backend()}; CPU runs force virtual "
+            "devices via "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=N "
+            "before jax is first imported)")
+    return Mesh(np.array(devs[:need]).reshape(n_lanes, n_peers),
+                (LANE_AXIS, PEER_AXIS))
+
+
+def compose_lane_peer_specs(lane_axes, peer_specs):
+    """Compose a fleet vmap-axes tree with a peer-axis PartitionSpec
+    tree into the 2-D spec tree: a lane-batched leaf gains a leading
+    ``LANE_AXIS`` dim ahead of its peer spec; an unbatched leaf (the
+    clock, the shared drop plane) keeps only its peer spec — which is
+    ``P()`` for the replicated plane, preserving the PR-3 shared-drop
+    rule in both mesh dimensions by construction.  The analyzer
+    re-derives this composition independently and fails
+    ``spec-derivation-consistent`` with the offending leaf path if the
+    two ever drift (analysis/sharding_flow.py)."""
+    cls = type(lane_axes)
+    out = {}
+    for f in dataclasses.fields(cls):
+        la = getattr(lane_axes, f.name)
+        ps = getattr(peer_specs, f.name)
+        out[f.name] = ps if la is None else P(LANE_AXIS, *ps)
+    return cls(**out)
+
+
+def make_lane_peer_bench_fn(cfg: SimConfig, mesh: Mesh,
+                            block_size: int = 128):
+    """The 2-D prototype program: the fleet's vmapped dense tick with
+    the RingComm peer exchange inside, scanned and shard_mapped over
+    ``Mesh((lanes, peers))`` with the carry donated.
+
+    Each lane's peer collectives stay within its own peer-axis submesh
+    and the lane axis moves zero bytes — per-lane results are
+    bit-identical to the 1-D lane fleet (tests/test_fleet_mesh.py runs
+    the parity on 8 virtual CPU devices).  Returns the raw jitted
+    program ``(states, scheds) -> (states, (sent, recv))``; serving is
+    NOT wired through this path yet (ROADMAP), but the program is
+    registered with the static analyzer (``mesh2d-lanes-peers``) so
+    the per-axis collective rules gate the wiring PR before it lands.
+    """
+    from .comm import RingComm
+    from .sharded import peer_spec_trees
+    ax = dict(zip(mesh.axis_names, mesh.devices.shape))
+    peer_axis = [a for a in mesh.axis_names if a != LANE_AXIS]
+    if LANE_AXIS not in ax or len(peer_axis) != 1:
+        raise ValueError(
+            f"make_lane_peer_bench_fn takes a 2-D ({LANE_AXIS!r}, "
+            f"peer) mesh, got axes {mesh.axis_names}")
+    peer_axis = peer_axis[0]
+    n_peers = ax[peer_axis]
+    if cfg.n % n_peers:
+        raise ValueError(
+            f"world of n={cfg.n} nodes does not divide over the "
+            f"{n_peers}-device {peer_axis!r} axis")
+    tick = make_tick(cfg, block_size, use_pallas=False,
+                     with_events=False,
+                     comm=RingComm(peer_axis, n_peers, use_pallas=False))
+    vtick = jax.vmap(tick, in_axes=(WORLD_AXES, SCHED_AXES_SHARED_DROP),
+                     out_axes=(WORLD_AXES, EVENT_AXES))
+    total = cfg.total_ticks
+
+    def body(states, scheds):
+        def step(carry, _):
+            carry, ev = vtick(carry, scheds)
+            return carry, (ev.sent, ev.recv)
+        return jax.lax.scan(step, states, None, length=total)
+
+    peer_state, peer_sched = peer_spec_trees(peer_axis)
+    state_specs = compose_lane_peer_specs(WORLD_AXES, peer_state)
+    sched_specs = compose_lane_peer_specs(SCHED_AXES_SHARED_DROP,
+                                          peer_sched)
+    # scan stacks ticks leading: (T, B, width) counters
+    cnt = P(None, LANE_AXIS, peer_axis)
+    return jax.jit(shard_map(body, mesh=mesh,
+                             in_specs=(state_specs, sched_specs),
+                             out_specs=(state_specs, (cnt, cnt))),
+                   donate_argnums=(0,))
 
 
 def _shardings(specs, mesh: Mesh):

@@ -55,6 +55,15 @@ def _sched_specs() -> Schedule:
                        for f in dataclasses.fields(Schedule)})
 
 
+def peer_spec_trees(axis: str = PEER_AXIS) -> tuple:
+    """The canonical peer-axis PartitionSpec trees ``(state, sched)``
+    — the building block both the 2-D lanes×peers composition
+    (parallel/fleet_mesh.py ``compose_lane_peer_specs``) and the
+    analyzer's independent spec derivation
+    (analysis/sharding_flow.py ``axes_tree_dims``) start from."""
+    return _state_specs(axis), _sched_specs()
+
+
 _SHARDED_CACHE: dict = {}
 
 

@@ -7,10 +7,12 @@ speedup, serving replay speedup (best recorded: mixed / mesh / the
 204-request curve's top row), p95 latency, device-wait fraction, the
 chaos gate, the open-loop load columns (max achieved rps + measured
 saturation point, PR 7+), the scenario-frontier columns (variants
-graded + oracle pass rate, PR 9+), and the durable-serving columns
-(kill/restart completion + spill volume, PR 12+; older jsons without
-an entry render "-") — so a regression (or a claimed win) is visible
-at a glance, PR over PR.
+graded + oracle pass rate, PR 9+), the durable-serving columns
+(kill/restart completion + spill volume, PR 12+), and the
+static-analysis columns (findings + rule-inventory size recorded by
+``bench --check``, PR 14+; older jsons without an entry render "-")
+— so a regression (or a claimed win) is visible at a glance, PR
+over PR.
 
     PYTHONPATH=. python scripts/bench_trajectory.py          # table
     PYTHONPATH=. python scripts/bench_trajectory.py --json   # rows
@@ -103,6 +105,10 @@ def load_rows():
         # completion across the death, zero restarts, digest parity,
         # and the spill tier's write volume
         recov = sec.get("service_recovery") or {}
+        # static-analysis entry (PR 14+): bench --check runs the
+        # jaxpr/sharding/ast passes in-process and records the
+        # verdict; older jsons without it render "-"
+        lint = d.get("analysis") or {}
         rows.append({
             "pr": pr,
             "backend": d.get("backend"),
@@ -135,6 +141,8 @@ def load_rows():
                 _get(recov, "durability", "spill_bytes") / 1e6
                 if _get(recov, "durability", "spill_bytes") is not None
                 else None),
+            "lint_findings": lint.get("findings"),
+            "lint_rules": lint.get("rules"),
         })
     return rows
 
@@ -170,7 +178,9 @@ def main(argv) -> int:
             ("scen", "scenario_variants", "{}"),
             ("scen ok", "scenario_pass_rate", "{:.0%}"),
             ("recov", "recovery_completion", "{:.0%}"),
-            ("spill MB", "recovery_spill_mb", "{:.1f}")]
+            ("spill MB", "recovery_spill_mb", "{:.1f}"),
+            ("lint", "lint_findings", "{}"),
+            ("rules", "lint_rules", "{}")]
     table = [[_fmt(r.get(key), spec) for _, key, spec in cols]
              for r in rows]
     widths = [max(len(h), *(len(t[i]) for t in table))

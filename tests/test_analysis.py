@@ -42,8 +42,15 @@ def test_rule_catalog_names_at_least_eight_rules():
     assert len(names) >= 8
     assert len(set(names)) == len(names)
     for r in RULES:
-        assert r.pass_name in ("jaxpr", "ast", "guard")
+        assert r.pass_name in ("jaxpr", "sharding", "ast", "guard")
         assert r.protects and r.origin
+    # the PR-14 sharding pass ships all four per-axis rules
+    sharding = {r.name for r in RULES if r.pass_name == "sharding"}
+    assert sharding == {"lanes-axis-zero-collectives",
+                        "peers-axis-collective-budget",
+                        "replicated-plane-stays-replicated",
+                        "spec-derivation-consistent"}
+    assert "journal-before-mutation" in names
 
 
 # ---- clean tree ------------------------------------------------------
@@ -80,6 +87,8 @@ def test_clean_tree_passes_jaxpr_audit():
     if jax.device_count() >= 2:
         assert "mesh-dense-bench-d2" in names
         assert "mesh-overlay-d2" in names
+    if jax.device_count() >= 8:
+        assert "mesh2d-lanes-peers" in names
 
 
 # ---- jaxpr rule fixtures ---------------------------------------------
@@ -221,6 +230,267 @@ def test_walker_reaches_nested_and_pallas_jaxprs():
     assert any("scan" in p for p, _ in hits), hits
 
 
+# ---- sharding-flow pass (PR 14) --------------------------------------
+from gossip_protocol_tpu.analysis import sharding_flow
+from gossip_protocol_tpu.analysis.sharding_flow import ShardingContract
+
+#: every registry entry by name, with the device floor that gates it
+#: (mesh entries SKIP — never silently pass — below their floor,
+#: the same discipline as the CLI roster)
+_REGISTRY_ROSTER = {
+    "solo-dense-trace": 1, "solo-overlay": 1, "fleet-dense-bench": 1,
+    "fleet-overlay": 1, "fleet-overlay-leg": 1, "grid-kernel": 1,
+    "mesh-dense-bench-d2": 2, "mesh-overlay-d2": 2,
+    "mesh2d-lanes-peers": 8,
+}
+
+
+@pytest.fixture(scope="module")
+def registered_programs():
+    progs = jaxpr_audit.audit.last_programs \
+        or jaxpr_audit.build_programs()
+    return {p.name: p for p in progs}
+
+
+@pytest.mark.parametrize("name", sorted(_REGISTRY_ROSTER))
+def test_sharding_flow_clean_per_program(registered_programs, name):
+    """Acceptance: the sharding-flow pass reports ZERO findings on
+    every registered program of the clean tree — including the 2-D
+    lanes×peers prototype, whose peer collectives must pass under
+    the axis-aware rules that replaced the blanket collective ban."""
+    need = _REGISTRY_ROSTER[name]
+    if jax.device_count() < need:
+        pytest.skip(f"needs {need} (virtual) devices")
+    prog = registered_programs[name]
+    assert prog.jaxpr is not None
+    if name.startswith("mesh"):
+        # every mesh entry carries a contract — a mesh program
+        # outside the sharding gate would be an unguarded program
+        assert prog.contract is not None
+        assert prog.contract.expected_in_names
+    findings = sharding_flow.check_program(prog)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+@needs_devices(8)
+def test_mesh2d_contract_shape(registered_programs):
+    """The flagship entry: 2-D axes, zero-collective lanes, a
+    declared peer budget, and the replicated plane derived as exactly
+    the unbatched leaves (clock + shared drop plane)."""
+    c = registered_programs["mesh2d-lanes-peers"].contract
+    assert c.mesh_axes == ("lanes", "peers")
+    assert c.zero_collective_axes == ("lanes",)
+    from gossip_protocol_tpu.parallel.fleet_mesh import \
+        LANE_PEER_TICK_COLLECTIVE_BUDGET
+    assert c.budgets == {"peers": LANE_PEER_TICK_COLLECTIVE_BUDGET}
+    assert "state.tick" in c.replicated_plane
+    assert "sched.drop_active" in c.replicated_plane
+
+
+def _mesh1d(axis):
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()[:2]), (axis,))
+
+
+@needs_devices(2)
+def test_lane_axis_collective_is_caught():
+    """Fixture: a collective smuggled onto the lanes axis fires
+    lanes-axis-zero-collectives with the eqn path."""
+    from jax.sharding import PartitionSpec as P
+
+    from gossip_protocol_tpu.compat.jaxapi import shard_map
+
+    def body(x):
+        return jax.lax.psum(x, "lanes")
+
+    f = jax.jit(shard_map(body, mesh=_mesh1d("lanes"),
+                          in_specs=(P("lanes"),), out_specs=P()))
+    prog = jaxpr_audit.AuditedProgram(
+        name="fixture-lane-psum", provenance="test_analysis",
+        jaxpr=jax.make_jaxpr(f)(jnp.ones((2, 4))), rules=(),
+        contract=ShardingContract(
+            mesh_axes=("lanes",), zero_collective_axes=("lanes",),
+            expected_in_names=(("x", {0: ("lanes",)}),)))
+    findings = sharding_flow.check_program(prog)
+    assert any(f.rule == "lanes-axis-zero-collectives"
+               for f in findings), findings
+    hit = [f for f in findings
+           if f.rule == "lanes-axis-zero-collectives"][0]
+    assert "psum" in hit.detail and "shard_map" in hit.path
+
+
+@needs_devices(2)
+def test_over_budget_peer_exchange_is_caught():
+    """Fixture: 3 static ppermutes inside the scanned body bust a
+    per-tick budget of 2 and pass a budget of 3 — the rule counts
+    STATIC eqns in the scan body, not dynamic trips."""
+    from jax.sharding import PartitionSpec as P
+
+    from gossip_protocol_tpu.compat.jaxapi import shard_map
+    perm = [(0, 1), (1, 0)]
+
+    def body(x):
+        def step(c, _):
+            for _ in range(3):
+                c = jax.lax.ppermute(c, "peers", perm)
+            return c, None
+        y, _ = jax.lax.scan(step, x, None, length=4)
+        return y
+
+    f = jax.jit(shard_map(body, mesh=_mesh1d("peers"),
+                          in_specs=(P("peers"),),
+                          out_specs=P("peers")))
+    jx = jax.make_jaxpr(f)(jnp.ones((2, 4)))
+
+    def prog(budget):
+        return jaxpr_audit.AuditedProgram(
+            name="fixture-peer-budget", provenance="test_analysis",
+            jaxpr=jx, rules=(),
+            contract=ShardingContract(
+                mesh_axes=("peers",), zero_collective_axes=(),
+                budgets={"peers": budget},
+                expected_in_names=(("x", {0: ("peers",)}),)))
+
+    busted = sharding_flow.check_program(prog(2))
+    assert any(f.rule == "peers-axis-collective-budget"
+               for f in busted), busted
+    assert "3" in busted[0].detail and "budget of 2" in busted[0].detail
+    assert sharding_flow.check_program(prog(3)) == []
+
+
+@needs_devices(2)
+def test_undeclared_axis_collective_is_caught():
+    """Fixture: a collective over an axis with NO declared budget
+    fires unconditionally (outside the scan too)."""
+    from jax.sharding import PartitionSpec as P
+
+    from gossip_protocol_tpu.compat.jaxapi import shard_map
+
+    def body(x):
+        return jax.lax.psum(x, "peers")
+
+    f = jax.jit(shard_map(body, mesh=_mesh1d("peers"),
+                          in_specs=(P("peers"),), out_specs=P()))
+    prog = jaxpr_audit.AuditedProgram(
+        name="fixture-undeclared-axis", provenance="test_analysis",
+        jaxpr=jax.make_jaxpr(f)(jnp.ones((2, 4))), rules=(),
+        contract=ShardingContract(
+            mesh_axes=("peers",), zero_collective_axes=(),
+            budgets={},
+            expected_in_names=(("x", {0: ("peers",)}),)))
+    findings = sharding_flow.check_program(prog)
+    assert any(f.rule == "peers-axis-collective-budget"
+               and "no declared per-tick budget" in f.detail
+               for f in findings), findings
+
+
+@needs_devices(2)
+def test_batched_drop_plane_is_caught_by_sharding_flow():
+    """Fixture: the batched-drop-plane bug, mesh edition — a plane
+    leaf entering the shard_map SHARDED fires both the boundary check
+    (replicated-plane + spec-derivation, with the leaf path) and the
+    dataflow check (the cond predicate becomes device-varying)."""
+    from jax.sharding import PartitionSpec as P
+
+    from gossip_protocol_tpu.compat.jaxapi import shard_map
+
+    def body(flag, x):
+        return jax.lax.cond(flag[0] > 0, lambda v: v + 1.0,
+                            lambda v: v - 1.0, x)
+
+    contract = ShardingContract(
+        mesh_axes=("lanes",), zero_collective_axes=("lanes",),
+        replicated_plane=("sched.drop_active",),
+        expected_in_names=(("sched.drop_active", {}),
+                           ("state.x", {0: ("lanes",)})))
+
+    bad = jax.jit(shard_map(body, mesh=_mesh1d("lanes"),
+                            in_specs=(P("lanes"), P("lanes")),
+                            out_specs=P("lanes")))
+    prog = jaxpr_audit.AuditedProgram(
+        name="fixture-batched-plane", provenance="test_analysis",
+        jaxpr=jax.make_jaxpr(bad)(jnp.ones((2,), jnp.int32),
+                                  jnp.ones((2, 4))),
+        rules=(), contract=contract)
+    findings = sharding_flow.check_program(prog)
+    rules_hit = {f.rule for f in findings}
+    assert "replicated-plane-stays-replicated" in rules_hit, findings
+    assert "spec-derivation-consistent" in rules_hit, findings
+    # the spec mismatch names the offending leaf path
+    assert any("sched.drop_active" in f.detail for f in findings
+               if f.rule == "spec-derivation-consistent")
+    # the dataflow side: the cond predicate went device-varying
+    assert any("predicate" in f.detail for f in findings
+               if f.rule == "replicated-plane-stays-replicated")
+
+    # the replicated build of the SAME program is clean
+    good = jax.jit(shard_map(body, mesh=_mesh1d("lanes"),
+                             in_specs=(P(), P("lanes")),
+                             out_specs=P("lanes")))
+    gprog = jaxpr_audit.AuditedProgram(
+        name="fixture-shared-plane", provenance="test_analysis",
+        jaxpr=jax.make_jaxpr(good)(jnp.ones((2,), jnp.int32),
+                                   jnp.ones((2, 4))),
+        rules=(), contract=contract)
+    assert sharding_flow.check_program(gprog) == []
+
+
+def test_spec_derivation_helpers_mirror_the_builders():
+    """axes_tree_dims derives the SAME dims the builders' spec
+    composition produces — the independent derivation the rule
+    cross-checks; and the replicated plane falls out as exactly the
+    unbatched leaves."""
+    from gossip_protocol_tpu.core.fleet import (SCHED_AXES_SHARED_DROP,
+                                                WORLD_AXES)
+    from gossip_protocol_tpu.parallel.sharded import peer_spec_trees
+    peer_state, peer_sched = peer_spec_trees()
+    dims = (sharding_flow.axes_tree_dims(
+                "state", WORLD_AXES, peer_specs=peer_state)
+            + sharding_flow.axes_tree_dims(
+                "sched", SCHED_AXES_SHARED_DROP,
+                peer_specs=peer_sched))
+    by_name = dict(dims)
+    # lane-batched + peer-row-sharded table: both axes, shifted
+    assert by_name["state.known"] == {0: ("lanes",), 1: ("peers",)}
+    # lane-batched, peer-replicated vector: lanes only
+    assert by_name["state.in_group"] == {0: ("lanes",)}
+    # the clock and the shared drop plane: no axis at all
+    assert by_name["state.tick"] == {}
+    assert by_name["sched.drop_active"] == {}
+
+
+# ---- donation-taken on the sharded path (PR-14 satellite) ------------
+@needs_devices(2)
+def test_donation_checked_on_sharded_path():
+    """The hardened donation rule reads the compiled executable's
+    input_output_alias as PRIMARY evidence — which is the only record
+    the shard_map path has (no MLIR marker).  Donating sharded
+    program passes; non-donating twin fires."""
+    from jax.sharding import PartitionSpec as P
+
+    from gossip_protocol_tpu.compat.jaxapi import shard_map
+
+    def body(x):
+        return x * 2.0
+
+    shm = shard_map(body, mesh=_mesh1d("lanes"),
+                    in_specs=(P("lanes"),), out_specs=P("lanes"))
+    x = jnp.ones((2, 4))
+    f_do = jax.jit(shm, donate_argnums=(0,))
+    f_no = jax.jit(shm)
+    good = jaxpr_audit.AuditedProgram(
+        name="fixture-sharded-donate", provenance="test_analysis",
+        jaxpr=jax.make_jaxpr(f_do)(x), lowered=f_do.lower(x),
+        rules=("donation-taken",))
+    assert jaxpr_audit.audit_program(good) == []
+    bad = jaxpr_audit.AuditedProgram(
+        name="fixture-sharded-no-donate", provenance="test_analysis",
+        jaxpr=jax.make_jaxpr(f_no)(x), lowered=f_no.lower(x),
+        rules=("donation-taken",))
+    findings = jaxpr_audit.audit_program(bad)
+    assert findings and findings[0].rule == "donation-taken"
+
+
 # ---- AST rule fixtures -----------------------------------------------
 def test_unseeded_rng_and_wall_clock_are_caught():
     src = """
@@ -305,6 +575,42 @@ def fine(lane, key, y):
     assert len(findings) == 4, [str(f) for f in findings]
     assert {f.where.split(":")[-1] for f in findings} == \
         {"5", "9", "13", "16"}
+
+
+def test_mutation_before_journal_is_caught():
+    """journal-before-mutation: a terminal setter (``._complete`` /
+    ``._fail``) with no ``journal.outcome(...)`` append textually
+    above it in the same function is the crash window the recovery
+    replay cannot close — the rule fires with the function's name."""
+    src = """
+class Scheduler:
+    def finish_ok(self, req, out):
+        self.journal.outcome(req.request_id, "completed")
+        req._complete(out)
+
+    def finish_bad(self, req, err):
+        req._fail(err)
+        self.journal.outcome(req.request_id, "failed", detail=err)
+
+    def finish_nested_is_skipped(self, req):
+        def later():
+            req._complete(None)
+        return later
+"""
+    findings = purity_lint.lint_source(
+        src, rule="journal-before-mutation")
+    assert len(findings) == 1, [str(f) for f in findings]
+    assert findings[0].rule == "journal-before-mutation"
+    assert findings[0].path == "finish_bad"
+    assert "_fail" in findings[0].detail
+
+
+def test_journal_order_clean_on_tree():
+    """The shipped scheduler/recovery modules journal before every
+    terminal setter — the rule's clean-tree half."""
+    findings = [f for f in purity_lint.lint()
+                if f.rule == "journal-before-mutation"]
+    assert findings == [], "\n".join(str(f) for f in findings)
 
 
 # ---- cache-key completeness ------------------------------------------
@@ -399,3 +705,49 @@ def test_guard_self_check_is_clean():
 def test_run_all_static_passes_clean():
     findings = run_all(passes=("ast",))
     assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# ---- the CLI front door (PR-14 satellite) ----------------------------
+def test_cli_preserves_flags_and_json_through_reexec():
+    """The module CLI re-execs itself to force virtual devices; the
+    full flag set (--pass/--rule/--json) must ride through the execv
+    — a re-exec that dropped argv would run the DEFAULT passes and
+    print the human report, so the assertions below pin both."""
+    import json
+    import os as _os
+    import subprocess
+    import sys as _sys
+    env = {k: v for k, v in _os.environ.items()
+           if k not in ("XLA_FLAGS", "_GOSSIP_ANALYSIS_REEXEC")}
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [_sys.executable, "-m", "gossip_protocol_tpu.analysis",
+         "--pass", "ast", "--rule", "journal-before-mutation",
+         "--json"],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is True
+    assert payload["passes"] == ["ast"]
+    assert payload["rules"] == ["journal-before-mutation"]
+    assert payload["programs"] == []
+    assert payload["count"] == 0
+
+
+def test_reexec_failure_exits_nonzero(monkeypatch):
+    """An execv that fails must exit 2, not fall through to an
+    in-process run with the mesh entries silently skipped (which
+    would read as a pass to the caller)."""
+    import gossip_protocol_tpu.analysis.__main__ as cli
+    monkeypatch.setenv("_GOSSIP_ANALYSIS_REEXEC", "0")
+    monkeypatch.setenv("XLA_FLAGS", "")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+
+    def refuse(*_a):
+        raise OSError("exec refused")
+
+    monkeypatch.setattr(cli.os, "execv", refuse)
+    with pytest.raises(SystemExit) as e:
+        cli._force_virtual_devices()
+    assert e.value.code == 2

@@ -259,3 +259,62 @@ def test_mesh_service_shard_divisible_padding_parity():
         m = h.metrics
         assert m.batch == 3 and m.padded_batch == 4
         assert m.padded_batch % 2 == 0
+
+
+# ---- 2-D lanes x peers prototype (PR 14) -----------------------------
+@needs_devices(8)
+def test_lane_peer_mesh_parity_with_fleet():
+    """The 2-D ``Mesh((lanes, peers))`` prototype — the fleet's
+    vmapped dense tick with the RingComm peer exchange inside,
+    composed via ``compose_lane_peer_specs`` — replays the 1-D lane
+    fleet bit-for-bit: final states AND per-tick sent/recv counters.
+    This is the program the static analyzer registers as
+    ``mesh2d-lanes-peers`` and holds to the per-axis collective
+    contract (analysis/sharding_flow.py)."""
+    import dataclasses
+
+    from gossip_protocol_tpu.parallel.fleet_mesh import (
+        make_lane_peer_bench_fn, make_lane_peer_mesh)
+    from gossip_protocol_tpu.state import WorldState
+
+    cfg = SimConfig(max_nnb=16, total_ticks=30, drop_msg=True,
+                    msg_drop_prob=0.1, single_failure=True)
+    cfgs = [cfg.replace(seed=s) for s in (1, 2)]
+    scheds = [make_schedule(c) for c in cfgs]
+
+    def args():
+        return (_stack_states([init_state(c) for c in cfgs]),
+                _stack_scheds(scheds, True))
+
+    mesh2 = make_lane_peer_mesh(2, 4)
+    jitted = make_lane_peer_bench_fn(cfg, mesh2)
+    out_states, (sent, recv) = jitted(*args())
+
+    ref_fn = FleetSimulation(cfg)._dense_bench_fn(2, cfg.n, True)
+    ref_states, (ref_sent, ref_recv) = ref_fn(*args())
+    assert np.array_equal(np.asarray(sent), np.asarray(ref_sent))
+    assert np.array_equal(np.asarray(recv), np.asarray(ref_recv))
+    for f in dataclasses.fields(WorldState):
+        assert np.array_equal(
+            np.asarray(getattr(out_states, f.name)),
+            np.asarray(getattr(ref_states, f.name))), \
+            f"2-D state field {f.name} diverged"
+
+
+@needs_devices(2)
+def test_lane_peer_mesh_rejects_bad_shapes():
+    """Actionable errors: too many devices asked for, a non-2-D mesh
+    handed to the builder, a world that does not divide the peer
+    axis."""
+    from gossip_protocol_tpu.parallel.fleet_mesh import (
+        make_lane_peer_bench_fn, make_lane_peer_mesh)
+    with pytest.raises(ValueError, match="devices are available"):
+        make_lane_peer_mesh(64, 64)
+    cfg = _dense_drop(n=24)
+    with pytest.raises(ValueError, match="2-D"):
+        make_lane_peer_bench_fn(cfg, make_lane_mesh(2))
+    if jax.device_count() >= 4:
+        with pytest.raises(ValueError, match="does not divide"):
+            # n=25 over 2 peers
+            make_lane_peer_bench_fn(cfg.replace(max_nnb=25),
+                                    make_lane_peer_mesh(2, 2))
