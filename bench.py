@@ -713,19 +713,22 @@ def main():
         lb["env"] = _env_provenance()
         secondary["service_load_openloop"] = lb
 
-        # scenario frontier (PR 9, docs/SCENARIOS.md): the adversarial
-        # failure-world catalog (models/scenarios.py — partitions that
-        # heal, asymmetric per-link loss, correlated failure waves,
-        # zombie peers, flapping members; both models) x N seeds,
-        # graded as ONE FleetService run with every variant's closed-
-        # form oracle verdict recorded.  scenarios.sweep raises unless
-        # 100% of variants reach a terminal state AND every oracle is
-        # green (failures print their exact single-variant repro), and
-        # the whole sweep is re-run and must reproduce verdict- and
-        # outcome-digest-for-digest — so this entry existing IS the
-        # scenario replay gate.
+        # scenario frontier (PR 9 + round 2, docs/SCENARIOS.md): the
+        # adversarial failure-world catalog (models/scenarios.py —
+        # partitions that heal, asymmetric per-link loss, correlated
+        # failure waves, zombie peers, flapping members, Byzantine
+        # liars, per-link latency, and the composed storms; both
+        # models) x N seeds, graded as ONE FleetService run with
+        # every variant's closed-form oracle verdict recorded.
+        # scenarios.sweep raises unless 100% of variants reach a
+        # terminal state AND every oracle is green (failures print
+        # their exact single-variant repro), and the whole sweep is
+        # re-run and must reproduce verdict- and outcome-digest-for-
+        # digest — so this entry existing IS the scenario replay
+        # gate.  Full (non-smoke) runs grade the ISSUE-15 bar: 25
+        # families x 40 seeds = 1000 variants.
         from gossip_protocol_tpu.models import scenarios
-        sc_seeds = 3 if smoke else 20
+        sc_seeds = 3 if smoke else 40
         sc = scenarios.sweep(seeds_per_family=sc_seeds)
         sc2 = scenarios.sweep(seeds_per_family=sc_seeds)
         if (sc2["verdict_digest"] != sc["verdict_digest"]

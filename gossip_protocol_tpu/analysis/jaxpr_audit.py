@@ -307,6 +307,39 @@ def build_programs(mesh_devices: int = 2) -> list[AuditedProgram]:
         rules=("cond-stays-cond", "zero-collectives-per-tick",
                "no-transfer-in-scan")))
 
+    # ---- composed-world programs (round 2: several planes layered) -
+    # The composed worlds are sweep hot programs now
+    # (models/scenarios.py dense_composed_* / overlay_composed_*):
+    # audit the exact traced form FleetService compiles — the forged
+    # byz planes and the message-age latency dimension must neither
+    # break cond structure nor smuggle per-tick collectives or
+    # transfers into the scan body.
+    ccfg = dcfg.replace(byz_rate=0.2, byz_boost=8, link_latency=3,
+                        flap_rate=0.3, flap_period=12, flap_down=4,
+                        partition_groups=2, partition_open_tick=8,
+                        partition_close_tick=16)
+    crun = make_run(ccfg, with_events=True, use_pallas=False)
+    cjx = jax.make_jaxpr(crun)(init_state(ccfg), make_schedule(ccfg))
+    progs.append(AuditedProgram(
+        name="solo-dense-composed", provenance=_provenance(make_run),
+        jaxpr=cjx, min_cond=1,
+        notes="byz + latency + flap + partition on the drop config",
+        rules=("cond-stays-cond", "zero-collectives-per-tick",
+               "no-transfer-in-scan")))
+
+    occfg = ocfg.replace(byz_rate=0.15, byz_boost=8, link_latency=3)
+    ocrun = make_overlay_run(occfg, use_pallas=False)
+    ocjx = jax.make_jaxpr(ocrun)(init_overlay_state(occfg),
+                                 make_overlay_schedule(occfg))
+    progs.append(AuditedProgram(
+        name="solo-overlay-composed",
+        provenance=_provenance(make_overlay_run),
+        jaxpr=ocjx, min_cond=1,
+        notes="byz + latency over the churn script (send-history "
+              "shift register rides the scan carry)",
+        rules=("cond-stays-cond", "zero-collectives-per-tick",
+               "no-transfer-in-scan")))
+
     # ---- lane-mesh programs (D=2) ----------------------------------
     import jax as _jax
 

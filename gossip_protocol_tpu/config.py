@@ -126,6 +126,23 @@ class SimConfig:
     flap_down: int = 8
     flap_open_tick: int = -1
     flap_close_tick: int = -1
+    #: Byzantine forgery plane (round 2): > 0 selects that fraction of
+    #: nodes as seeded liars (introducer exempt).  Liars inflate their
+    #: own heartbeat counter, relay their table at forged freshness
+    #: with heartbeats inflated by ``byz_boost``, and advertise a
+    #: hashed set of ghost members they have never heard from.  The
+    #: direct-sender-credit defense (liveness evidence is direct-only)
+    #: compiles in with the plane — see worlds.py.
+    byz_rate: float = 0.0
+    byz_boost: int = 8
+    #: Per-link latency plane (round 2): maximum EXTRA delivery delay
+    #: in ticks.  Link (i -> j) delivers gossip after
+    #: ``1 + mix32(seed, i*n+j, SALT_LAT) % (link_latency + 1)`` ticks
+    #: (same hashed-link construction as asym_drop); 0 disables —
+    #: every link keeps the reference's one-tick delivery.  Applies to
+    #: gossip only (the introducer join path stays one-tick, so the
+    #: segment planner's join windows are untouched).
+    link_latency: int = 0
 
     def __post_init__(self):
         if self.model == "overlay":
@@ -204,6 +221,28 @@ class SimConfig:
                     f"single down phase of {self.flap_down} ticks — "
                     "no node would ever flap; widen the window or "
                     "shrink flap_down")
+        if self.byz_rate < 0 or self.byz_rate > 1:
+            raise ValueError(
+                f"byz_rate must be in [0, 1], got {self.byz_rate}")
+        if self.byz_rate > 0 and self.byz_boost < 1:
+            raise ValueError(
+                f"the Byzantine plane needs byz_boost >= 1 (a 0-boost "
+                f"liar forges nothing), got {self.byz_boost}")
+        if self.link_latency < 0 or self.link_latency > 23:
+            # delays draw in [1, link_latency + 1], so 23 caps the
+            # overlay's send-history bitmask at 24 bits — f32 is exact
+            # only for integers below 2^24, and the history word rides
+            # the f32 permutation matmuls
+            raise ValueError(
+                f"link_latency must be in [0, 23] ticks, got "
+                f"{self.link_latency}")
+        if self.link_latency > 0 \
+                and self.link_latency + 1 >= self.t_remove:
+            raise ValueError(
+                f"link_latency={self.link_latency} reaches the "
+                f"staleness horizon t_remove={self.t_remove}: a clean "
+                "slow link would manufacture false removals; keep "
+                "link_latency + 1 < t_remove")
 
     def worlds_key(self) -> tuple:
         """Hashable digest of the ACTIVE adversarial worlds — the
@@ -228,11 +267,22 @@ class SimConfig:
             ws.append(("flap", self.flap_rate, self.flap_period,
                        self.flap_down, self.flap_open_tick,
                        self.flap_close_tick))
+        if self.byz_rate > 0:
+            ws.append(("byz", self.byz_rate, self.byz_boost))
+        if self.link_latency > 0:
+            ws.append(("lat", self.link_latency))
         return tuple(ws)
 
     @property
     def has_worlds(self) -> bool:
         return bool(self.worlds_key())
+
+    @property
+    def has_latency(self) -> bool:
+        """The per-link latency plane is on (kernel gates check this
+        explicitly, though ``lat`` in :meth:`worlds_key` already routes
+        latency configs off every fused path via ``has_worlds``)."""
+        return self.link_latency > 0
 
     @property
     def n(self) -> int:

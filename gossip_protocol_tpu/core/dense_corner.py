@@ -113,6 +113,7 @@ def _slice_state(state: WorldState, a: int) -> WorldState:
         in_group=state.in_group[:a], own_hb=state.own_hb[:a],
         known=state.known[:a, :a], hb=state.hb[:a, :a],
         ts=state.ts[:a, :a], gossip=state.gossip[:a, :a],
+        gossip_age=state.gossip_age[:a, :a],
         joinreq=state.joinreq[:a], joinrep=state.joinrep[:a])
 
 
@@ -130,6 +131,7 @@ def _embed_state(state_a: WorldState, n: int) -> WorldState:
         in_group=vec(state_a.in_group), own_hb=vec(state_a.own_hb),
         known=plane(state_a.known), hb=plane(state_a.hb),
         ts=plane(state_a.ts), gossip=plane(state_a.gossip),
+        gossip_age=plane(state_a.gossip_age),
         joinreq=vec(state_a.joinreq), joinrep=vec(state_a.joinrep))
 
 

@@ -84,11 +84,13 @@ def make_dense_mega_run(cfg: SimConfig, with_events: bool = False,
 
     def unpack(planes, aux, tick, rng) -> WorldState:
         known, hb, ts, gossip = planes
+        # the mega envelope excludes the latency plane (make_run gates
+        # on worlds_key), so the age plane is identically zero here
         return WorldState(
             tick=tick.astype(jnp.int32), in_group=aux[:, 0] > 0,
             own_hb=aux[:, 1], known=known > 0, hb=hb, ts=ts,
-            gossip=gossip > 0, joinreq=aux[:, 2] > 0,
-            joinrep=aux[:, 3] > 0, rng=rng)
+            gossip=gossip > 0, gossip_age=jnp.zeros((n, n), jnp.int32),
+            joinreq=aux[:, 2] > 0, joinrep=aux[:, 3] > 0, rng=rng)
 
     def launch(planes, aux, t, state_rng, sched, s_ticks):
         g, q, p = drop_stack(state_rng, t, s_ticks, sched)

@@ -60,7 +60,8 @@ from .tick import TickEvents, make_tick
 #: vmap axes of a stacked fleet: every lane carries its own arrays but
 #: the CLOCK is shared (see module docstring), so ``tick`` is None
 WORLD_AXES = WorldState(tick=None, in_group=0, own_hb=0, known=0, hb=0,
-                        ts=0, gossip=0, joinreq=0, joinrep=0, rng=0)
+                        ts=0, gossip=0, gossip_age=0, joinreq=0,
+                        joinrep=0, rng=0)
 EVENT_AXES = TickEvents(added=0, removed=0, sent=0, recv=0)
 
 #: Schedule axes when every lane shares one drop plan: the per-lane
@@ -86,13 +87,16 @@ SCHED_AXES_SHARED_DROP = Schedule(start_tick=0, fail_tick=0,
                                   part_open=None, part_close=None,
                                   link_prob=0, flap_mask=0,
                                   flap_phase=0, flap_period=0,
-                                  flap_down=0, flap_close=0)
+                                  flap_down=0, flap_close=0,
+                                  byz_mask=0, byz_target=0, byz_boost=0,
+                                  link_lat=0)
 SCHED_AXES_BATCHED = Schedule(start_tick=0, fail_tick=0, rejoin_tick=0,
                               drop_active=0, drop_prob=0,
                               part_group=0, part_on=0, part_open=0,
                               part_close=0, link_prob=0, flap_mask=0,
                               flap_phase=0, flap_period=0, flap_down=0,
-                              flap_close=0)
+                              flap_close=0, byz_mask=0, byz_target=0,
+                              byz_boost=0, link_lat=0)
 
 
 def _shared_drop(cfgs) -> bool:
@@ -226,6 +230,7 @@ def _embed_state_host(state_a, n: int):
         in_group=vec(state_a.in_group), own_hb=vec(state_a.own_hb),
         known=plane(state_a.known), hb=plane(state_a.hb),
         ts=plane(state_a.ts), gossip=plane(state_a.gossip),
+        gossip_age=plane(state_a.gossip_age),
         joinreq=vec(state_a.joinreq), joinrep=vec(state_a.joinrep))
 
 

@@ -110,6 +110,12 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
     partition = cfg.partition_groups >= 2
     asym = cfg.asym_drop
     zombie = cfg.zombie
+    # round-2 planes (worlds.py): byz forges the merge payload planes
+    # and changes the timestamp rules (the direct-only-credit defense);
+    # latency adds the message-age dimension — neither is compiled by
+    # the fused kernel
+    byz = cfg.byz_rate > 0
+    latency = cfg.link_latency > 0
     assert n % comm.n_shards == 0, "peer count must divide the mesh axis"
     # the fused epilogue kernel needs its tile divisibility (row tile
     # 64, sublane-aligned — mirrors the asserts in fused_tick_update)
@@ -122,7 +128,7 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
     _tr = min(64, n)
     fused = (isinstance(comm, LocalComm) and comm.use_pallas
              and n <= 4096 and n % _tr == 0 and _tr % 8 == 0
-             and not zombie)
+             and not zombie and not byz and not latency)
 
     def tick(state: WorldState, sched: Schedule):
         t = state.tick
@@ -161,7 +167,20 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
             st_in_group, st_own_hb = state.in_group, state.own_hb
 
         # ---- phase A: consume in-flight traffic --------------------
-        deliver = state.gossip & proc[None, :]           # [rows=s, r] consumed now
+        if latency:
+            # per-link delay (worlds.py latency plane): a message sent
+            # at t0 carries age t - t0 - 1 in gossip_age; it becomes
+            # deliverable once it has been in flight lat(s, r) ticks.
+            # Undelivered messages keep aging in place (at most one in
+            # flight per link — a busy link skips the new send below),
+            # and traffic to failed receivers rots like the
+            # non-latency path's buffer rule.
+            lat_l = comm.slice_rows(sched.link_lat)      # [rows=s, r]
+            age1 = state.gossip_age + 1                  # ticks since send
+            deliver = state.gossip & (age1 >= lat_l) & proc[None, :]
+            held = state.gossip & ~deliver & ~failed[None, :]
+        else:
+            deliver = state.gossip & proc[None, :]       # [rows=s, r] consumed now
         jreq = state.joinreq & proc[INTRODUCER]          # requests the introducer processes
         jrep = state.joinrep & proc                      # JOINREPs joiners process
         recv_from = comm.transpose(deliver)              # [rows=r, s]
@@ -248,13 +267,33 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
             new_state = WorldState(
                 tick=t + 1, in_group=in_group, own_hb=own_hb,
                 known=known, hb=hb, ts=ts, gossip=gossip_next,
+                gossip_age=state.gossip_age,
                 joinreq=joinreq_next, joinrep=joinrep_next, rng=state.rng)
             return new_state, events
 
         # ---- checkMessages: GOSSIP piggyback merge -----------------
         # (MP1Node.cpp:244-256; add path MP1Node.cpp:282-301)
+        if byz:
+            # Byzantine forgery plane (worlds.py): liar senders present
+            # a FORGED view to the merge — their whole heartbeat row
+            # boosted (the diagonal cell is the classic inflate-your-
+            # own-counter attack), ghost/fake target entries
+            # advertised, everything stamped at forged freshness t-1.
+            # Only the transmitted planes are forged: the liar's true
+            # local table, detection, and direct-credit behaviour are
+            # untouched, and a targeted false accusation has no
+            # transport at all — the strictly-larger-heartbeat merge
+            # can only raise counters, never retract them.
+            liar_rows = sched.byz_mask[row_ids]          # local sender rows
+            tgt_rows = comm.slice_rows(sched.byz_target)
+            f_known = st_known | tgt_rows
+            f_hb = jnp.where(liar_rows[:, None],
+                             st_hb + sched.byz_boost, st_hb)
+            f_ts = jnp.where(liar_rows[:, None], t - 1, st_ts)
+        else:
+            f_known, f_hb, f_ts = st_known, st_hb, st_ts
         m_hb_all, m_hb_fresh, m_ts_fresh, any_fresh = comm.merge_reduce(
-            recv_from, st_known, st_hb, st_ts, t,
+            recv_from, f_known, f_hb, f_ts, t,
             t_remove=t_remove, block_size=block_size)
 
         exists = st_known
@@ -262,7 +301,17 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
         # and refresh the timestamp (MP1Node.cpp:248-251)
         inc = exists & (m_hb_all > st_hb)
         hb = jnp.where(inc, m_hb_all, st_hb)
-        ts = jnp.where(inc, t, st_ts)
+        if byz:
+            # Defense: relayed counters are NOT liveness evidence once
+            # forgery is in play — a merged-up heartbeat earns no
+            # timestamp refresh; only direct-sender credit (below)
+            # proves liveness.  In the dense full-view model every live
+            # pair exchanges a direct message every tick, so honest
+            # freshness never depends on the piggyback refresh and
+            # detection horizons are unchanged.
+            ts = st_ts
+        else:
+            ts = jnp.where(inc, t, st_ts)
         # add unknown entries if some contribution is fresh
         # (freshness gate at receive time, MP1Node.cpp:294); never self
         # (MP1Node.cpp:290-293).  The entry value mirrors "copy the
@@ -270,7 +319,13 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
         # the local clock" under the canonical order.
         padd = ~exists & any_fresh & ~self_mask
         hb = jnp.where(padd, m_hb_all, hb)
-        ts = jnp.where(padd, jnp.where(m_hb_all > m_hb_fresh, t, m_ts_fresh), ts)
+        if byz:
+            # forged adds start their staleness clock at arrival: an
+            # entry no liar keeps re-advertising is purged within
+            # t_remove + 1 ticks of its last advertisement
+            ts = jnp.where(padd, t, ts)
+        else:
+            ts = jnp.where(padd, jnp.where(m_hb_all > m_hb_fresh, t, m_ts_fresh), ts)
 
         # ---- checkMessages: GOSSIP direct-sender handling ----------
         # A known sender's heartbeat is *incremented* locally (not
@@ -284,7 +339,16 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
         # ordinary strictly-larger-heartbeat rule above.
         known_pb = exists | padd
         dcred = recv_from
-        if zombie:
+        if zombie and latency:
+            # with per-link delay the liveness claim is dated at the
+            # message's TRUE send tick t - age (per link), not t - 1:
+            # evaluate the fail window per (sender, receiver) cell on
+            # the sender-major layout, then transpose into receiver rows
+            sent_t = t - age1                            # [rows=s, r]
+            zbad = (sent_t > sched.fail_tick[row_ids][:, None]) \
+                & (sent_t <= sched.rejoin_tick[row_ids][:, None])
+            dcred = dcred & ~comm.transpose(zbad)
+        elif zombie:
             dcred = dcred & ~sched.window_failed_at(t - 1)[None, :]
         dinc = dcred & known_pb
         hb = jnp.where(dinc, hb + 1, hb)
@@ -340,7 +404,22 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
         # to failed receivers, which in the reference rots in the buffer
         # forever (failed nodes never call recvLoop again,
         # Application.cpp:130, MP1Node.cpp:42-44) and is dropped here.
-        gossip_next = gossip_sent | (state.gossip & live_hold[None, :])
+        if latency:
+            # at most one message in flight per link: a busy link
+            # (held traffic) skips this tick's send entirely, so the
+            # effective gossip cadence on a lat-tick link is one
+            # message every lat ticks.  Payloads are delivery-delayed
+            # but content-current (the bool plane carries "a message is
+            # in flight"; its payload is the sender's row at delivery-
+            # check time) — the age plane exists to date delivery and
+            # zombie credit, not to freeze content.
+            gossip_sent = gossip_sent & ~held
+            gossip_next = gossip_sent | held
+            gossip_age = jnp.where(held, age1, 0)
+        else:
+            gossip_next = gossip_sent | (state.gossip & live_hold[None, :])
+            gossip_age = state.gossip_age
+
         joinreq_next = joinreq_sent | (state.joinreq
                                        & ~proc[INTRODUCER] & ~failed[INTRODUCER])
         joinrep_next = joinrep_sent | (state.joinrep & live_hold)
@@ -370,6 +449,7 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
             hb=hb,
             ts=ts,
             gossip=gossip_next,
+            gossip_age=gossip_age,
             joinreq=joinreq_next,
             joinrep=joinrep_next,
             rng=state.rng,

@@ -103,8 +103,9 @@ from flax import struct
 from ..config import INTRODUCER, SimConfig
 from ..state import NEVER
 from ..utils.hash32 import mix32, threshold32
-from ..worlds import (SALT_FLAP, SALT_FLAP_PHASE, SALT_LINK, SALT_PART,
-                      flap_threshold, flap_window, partition_window,
+from ..worlds import (SALT_BYZ, SALT_FLAP, SALT_FLAP_PHASE, SALT_LINK,
+                      SALT_PART, byz_threshold, flap_threshold,
+                      flap_window, link_latency_of, partition_window,
                       wave_center, wave_start)
 
 #: id field width in the packed priority key: ids < 2^20, and the XOR
@@ -143,6 +144,12 @@ class OverlayState:
     own_hb: jax.Array      # i32[N]
     send_flags: jax.Array  # bool[N, F] — node gossiped on exchange slot f
                            #   last tick (in-flight traffic marker)
+    send_hist: jax.Array   # i32[N, F] — latency plane only: per-slot
+                           #   send shift register (bit a = sent a+1
+                           #   ticks ago; bit 0 mirrors send_flags; at
+                           #   most 24 bits, so the word rides the f32
+                           #   permutation matmuls exactly).  Inert
+                           #   all-zero when cfg.link_latency == 0.
     joinreq: jax.Array     # bool[N] — JOINREQ to the introducer in flight
     joinrep: jax.Array     # bool[N] — JOINREP back to the joiner in flight
 
@@ -193,6 +200,11 @@ class OverlaySchedule:
     flap_down: jax.Array    # i32 — down ticks per period
     flap_open: jax.Array    # i32 — resolved window
     flap_close: jax.Array   # i32
+    byz_thr: jax.Array      # u32 — Byzantine liar threshold (0 = off)
+    byz_boost: jax.Array    # i32 — forged heartbeat inflation
+    link_lat: jax.Array     # i32 — per-link latency bound L (0 = off);
+                            #   link delays draw in [1, L+1] via
+                            #   worlds.link_latency_of
 
     def start_of(self, i):
         return (i * self.step_num) // self.step_den
@@ -248,6 +260,13 @@ class OverlaySchedule:
             & (anchor + c * per + self.flap_down <= self.flap_close)
         return (ok & (off >= 1) & (off <= self.flap_down),
                 ok & (off == self.flap_down))
+
+    def byz_of(self, i):
+        """bool: node ``i`` is a seeded liar (byz plane; the introducer
+        never lies — :func:`worlds.byz_mask_host` is the host twin)."""
+        iu = i.astype(jnp.uint32) if hasattr(i, "astype") else np.uint32(i)
+        sel = mix32(self.seed, iu, np.uint32(SALT_BYZ)) < self.byz_thr
+        return sel & (i != INTRODUCER)
 
     def window_failed_at(self, i, t):
         """The WINDOW component of :meth:`failed_at` (scripted / churn
@@ -368,6 +387,9 @@ def make_overlay_schedule(cfg: SimConfig) -> OverlaySchedule:
         flap_down=np.int32(cfg.flap_down),
         flap_open=np.int32(flap_lo),
         flap_close=np.int32(flap_hi if cfg.flap_rate > 0 else -1),
+        byz_thr=np.uint32(byz_threshold(cfg)),
+        byz_boost=np.int32(cfg.byz_boost),
+        link_lat=np.int32(cfg.link_latency),
     )
 
 
@@ -471,6 +493,7 @@ def init_overlay_state(cfg: SimConfig) -> OverlayState:
         in_group=jnp.zeros(n, bool),
         own_hb=jnp.zeros(n, jnp.int32),
         send_flags=jnp.zeros((n, f), bool),
+        send_hist=jnp.zeros((n, f), jnp.int32),
         joinreq=jnp.zeros(n, bool),
         joinrep=jnp.zeros(n, bool),
     )
@@ -597,6 +620,14 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
     asym = cfg.asym_drop
     zomb = cfg.zombie
     flap = cfg.flap_rate > 0
+    # round-2 planes (worlds.py).  byz: liar senders ship forged relay
+    # freshness and boosted counters, and never purge (the shield
+    # attack); honest receivers clamp relayed freshness to the honest
+    # maximum t-2 — a no-op for honest traffic.  latency: each link
+    # delays delivery by a seeded [1, L+1]-tick lag read off the
+    # sender's send-history shift register.
+    byz = cfg.byz_rate > 0
+    latency = cfg.link_latency > 0
     # flap up-edges are rejoin events (fresh-nodeStart wipes), so the
     # flap world compiles the churn/rejoin path in
     can_rejoin = cfg.churn_rate > 0 or cfg.rejoin_after is not None \
@@ -715,6 +746,13 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
             ids0, hb0, ts0 = state.ids, state.hb, state.ts
             in_group0, own_hb0 = state.in_group, state.own_hb
         own_hb0_l = comm.slice_rows(own_hb0)
+        if latency:
+            # a rejoin is a fresh nodeStart: the node's in-flight
+            # stream dies with the wipe (the dense model's buffer
+            # instead lets pre-fail traffic deliver late — each model
+            # documents its own buffer semantics)
+            hist0 = state.send_hist * keep_l[:, None] if can_rejoin \
+                else state.send_hist
 
         # ---- payload of the send tick t-1 --------------------------
         # the sender's whole K-slot view + its self-entry, all from
@@ -886,8 +924,45 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
                 in_p = q[:, k:2 * k].astype(jnp.int32)
                 in_ts = (in_p >> 12) - 1
                 own_p = q[:, 2 * k].astype(jnp.int32)
-                sent_flag = q[:, 2 * k + 1] > 0.5
+                if latency:
+                    # latency plane: the round delivers the message the
+                    # partner sent lat(p, r) ticks ago on this exchange
+                    # slot — bit lat-1 of its send-history word (the
+                    # pairing mask is evaluated at delivery time, the
+                    # sent bit and the self-entry's observation date at
+                    # the true send tick).  Payloads stay content-
+                    # current, like the dense plane.
+                    lat_pr = link_latency_of(
+                        seed, partner.astype(jnp.uint32), rows_u,
+                        n, cfg.link_latency)
+                    hist_w = q[:, 2 * k + 1].astype(jnp.int32)
+                    sent_flag = ((hist_w >> (lat_pr - 1)) & 1) > 0
+                    self_ts = t - lat_pr
+                else:
+                    sent_flag = q[:, 2 * k + 1] > 0.5
+                    self_ts = jnp.broadcast_to(t - 1, (nl,))
                 ok = sent_flag & proc_l
+                if byz:
+                    # defense first: relayed freshness is clamped to
+                    # the honest maximum t-2 (stored tables never carry
+                    # a newer stamp — a no-op for honest traffic).  The
+                    # forgery then claims exactly that maximum on every
+                    # liar entry with boosted counters: the liar's own
+                    # diagonal slot is the inflate-your-own-heartbeat
+                    # attack, its retained victim entries (no purge
+                    # below) the shield attack.
+                    liar_p = sched.byz_of(partner)
+                    in_hb = jnp.where(in_ids >= 0, (in_p & 0xFFF) - 1, 0)
+                    in_ts = jnp.minimum(in_ts, t - 2)
+                    in_ts = jnp.where(liar_p[:, None], t - 2, in_ts)
+                    in_hb = jnp.where(
+                        liar_p[:, None],
+                        jnp.minimum(in_hb + sched.byz_boost, 4093),
+                        in_hb)
+                    in_p = jnp.where(in_ids >= 0,
+                                     _pack_th(in_ts, in_hb), 0)
+                    own_p = jnp.where(liar_p, own_p + sched.byz_boost,
+                                      own_p)
                 valid = ok[:, None] & (in_ids >= 0) \
                     & (t - in_ts < t_remove) & (in_ids != rows_g[:, None])
                 recv_cnt += ok.sum().astype(jnp.int32)
@@ -901,17 +976,21 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
                         # liveness claim is dated at the fail tick, not
                         # the send tick, so it earns no direct
                         # self-entry; its stale table rows still merged
-                        # above under the ordinary freshness gates
+                        # above under the ordinary freshness gates.
+                        # Under latency the claim is dated at the TRUE
+                        # send tick t - lat (config validation keeps
+                        # every lat below the t_remove horizon).
                         cred = ok & ~sched.window_failed_at(partner,
-                                                            t - 1)
+                                                            self_ts)
                     keymax, p_acc = entry_merge(
-                        keymax, p_acc, partner,
-                        jnp.broadcast_to(t - 1, (nl,)), own_p, cred)
+                        keymax, p_acc, partner, self_ts, own_p, cred)
                 return (keymax, p_acc, recv_cnt), None
 
+            flight = hist0.astype(jnp.float32) if latency \
+                else state.send_flags.astype(jnp.float32)
             (keymax, p_acc, recv_cnt), _ = jax.lax.scan(
                 exchange_round, (keymax, p_acc, recv_cnt),
-                (masks, state.send_flags.astype(jnp.float32).T))
+                (masks, flight.T))
             recv_cnt = comm.psum(recv_cnt)
 
             # ---- JOINREP (introducer's payload broadcast) ----------
@@ -956,6 +1035,13 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
 
             # ---- detection (nodeLoopOps analog) --------------------
             stale = (ids1 >= 0) & (t - ts1 >= t_remove) & ops_l[:, None]
+            if byz:
+                # liars never purge: retained dead entries keep being
+                # re-advertised at forged freshness — the shield attack
+                # (an honest dense receiver defeats it via direct-only
+                # credit; the unauthenticated overlay documents it as a
+                # real limit, bounded only by slot-priority eviction)
+                stale = stale & ~comm.slice_rows(sched.byz_of(rows))[:, None]
             subj = jnp.clip(ids1, 0)
             subj_fail = sched.fail_of(subj)
             subj_failed = (t > subj_fail) & (t <= sched.rejoin_of(subj))
@@ -1090,6 +1176,15 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
             + joinreq_sent.sum().astype(jnp.int32) \
             + joinrep_sent.sum().astype(jnp.int32)
 
+        if latency:
+            # shift the send history: bit 0 = sent this tick (mirrors
+            # send_flags), bit a = sent a ticks before that; the word
+            # is capped at the largest drawable delay L+1 (<= 24 bits)
+            send_hist = ((hist0 << 1) | send_flags.astype(jnp.int32)) \
+                & ((1 << (cfg.link_latency + 1)) - 1)
+        else:
+            send_hist = state.send_hist
+
         live_hold = ~proc & ~failed
         joinreq_next = joinreq_sent | (state.joinreq
                                        & ~proc[INTRODUCER] & ~failed[INTRODUCER])
@@ -1119,7 +1214,7 @@ def make_overlay_tick(cfg: SimConfig, comm=None,
             tick=t + 1,
             ids=ids2, hb=hb2, ts=ts2,
             in_group=in_group, own_hb=own_hb,
-            send_flags=send_flags,
+            send_flags=send_flags, send_hist=send_hist,
             joinreq=joinreq_next, joinrep=joinrep_next,
         )
         return new_state, metrics
@@ -1249,8 +1344,8 @@ _OVERLAY_FLEET_CACHE: dict = {}
 #: construction.
 OVERLAY_FLEET_STATE_AXES = OverlayState(tick=None, ids=0, hb=0, ts=0,
                                         in_group=0, own_hb=0,
-                                        send_flags=0, joinreq=0,
-                                        joinrep=0)
+                                        send_flags=0, send_hist=0,
+                                        joinreq=0, joinrep=0)
 
 
 def make_overlay_fleet_run(cfg: SimConfig, batch: int,
@@ -1337,7 +1432,7 @@ def _overlay_expect(host):
     f = np.asarray(host["send_flags"]).shape[1]
     return {"tick": (), "ids": (n, k), "hb": (n, k), "ts": (n, k),
             "in_group": (n,), "own_hb": (n,), "send_flags": (n, f),
-            "joinreq": (n,), "joinrep": (n,)}
+            "send_hist": (n, f), "joinreq": (n,), "joinrep": (n,)}
 
 
 def overlay_state_to_host(state: OverlayState) -> dict:

@@ -61,8 +61,12 @@ def grid_supported(cfg: SimConfig) -> bool:
             and cfg.total_ticks <= 4094
             and num * (n - 1) < 2 ** 31
             # the adversarial worlds (worlds.py) are not compiled into
-            # the grid kernel — world configs take the XLA tick
-            and not cfg.has_worlds)
+            # the grid kernel — world configs take the XLA tick.  The
+            # latency plane is pinned explicitly on top of has_worlds:
+            # its message-age state dimension (send_hist) is structural
+            # — the packed plane has no lane for it — not merely a
+            # routing choice
+            and not cfg.has_worlds and not cfg.has_latency)
 
 
 def _grid_kern_kwargs(cfg: SimConfig, k: int, f: int, b: int) -> dict:
@@ -133,6 +137,8 @@ def unpack_grid_plane(cfg: SimConfig, plane, tick) -> OverlayState:
         in_group=(a1[:, 0] & 0x10) > 0,
         own_hb=own_hb[:, 0],
         send_flags=((sf >> fis) & 1) > 0,
+        # the grid envelope excludes the latency plane (grid_supported)
+        send_hist=jnp.zeros((ids.shape[0], f), jnp.int32),
         joinreq=(a1[:, 0] & 0x20) > 0,
         joinrep=(a1[:, 0] & 0x40) > 0,
     )
@@ -309,7 +315,7 @@ def make_grid_run(cfg: SimConfig, length: int,
 #: stays an unbatched scalar
 FLEET_STATE_AXES = OverlayState(
     tick=None, ids=0, hb=0, ts=0, in_group=0, own_hb=0,
-    send_flags=0, joinreq=0, joinrep=0)
+    send_flags=0, send_hist=0, joinreq=0, joinrep=0)
 
 
 def make_grid_fleet_run(cfg: SimConfig, length: int, batch: int,

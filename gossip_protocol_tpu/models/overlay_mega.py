@@ -58,8 +58,12 @@ def mega_supported(cfg: SimConfig) -> bool:
             # 4094 ticks (make_overlay_tick asserts the same bound)
             and cfg.total_ticks <= 4094
             # the adversarial worlds (worlds.py) are not compiled into
-            # the megakernel — world configs take the XLA tick
-            and not cfg.has_worlds)
+            # the megakernel — world configs take the XLA tick.  The
+            # latency plane is pinned explicitly on top of has_worlds:
+            # its message-age state dimension (send_hist) is structural
+            # — the packed plane has no lane for it — not merely a
+            # routing choice
+            and not cfg.has_worlds and not cfg.has_latency)
 
 
 def _pack_state(cfg: SimConfig, state: OverlayState,
@@ -103,6 +107,9 @@ def _unpack_state(cfg: SimConfig, plane, tick) -> OverlayState:
         in_group=plane[:, a + 0] > 0,
         own_hb=plane[:, a + 1],
         send_flags=plane[:, a + 4:a + 4 + f] > 0,
+        # the mega envelope excludes the latency plane
+        # (mega_supported), so the history word is identically zero
+        send_hist=jnp.zeros((n, f), jnp.int32),
         joinreq=plane[:, a + 2] > 0,
         joinrep=plane[:, a + 3] > 0,
     )

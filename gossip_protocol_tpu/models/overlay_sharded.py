@@ -96,7 +96,7 @@ def _state_specs(axis: str) -> OverlayState:
     rep = P()
     return OverlayState(tick=rep, ids=mat, hb=mat, ts=mat,
                         in_group=rep, own_hb=rep, send_flags=mat,
-                        joinreq=rep, joinrep=rep)
+                        send_hist=mat, joinreq=rep, joinrep=rep)
 
 
 def _sched_specs() -> OverlaySchedule:

@@ -134,6 +134,15 @@ def phase_windows(cfg: SimConfig) -> PhaseWindows:
     drop plane: sends can be blocked exactly while either is open).
     Seeds move which nodes are hit, never these windows — that
     invariance is what lets every lane of a fleet share one plan.
+
+    The round-2 BYZ and LATENCY planes are windowless: liars lie for
+    the whole run, and per-link delay shifts deliveries, not fail
+    schedules or send gates (the join path stays one-tick, so
+    ``join_dead_from`` holds under latency too).  They enter plan
+    identity through ``worlds_key`` in :func:`plan_signature` rather
+    than through any window here — which is exactly how the
+    composition grammar (worlds.composition) stays closed: any plane
+    subset folds to one window set plus the worlds-key tail.
     """
     n, total = cfg.n, cfg.total_ticks
     num, den = step_fraction(cfg.step_rate)

@@ -44,7 +44,8 @@ def _state_specs(axis: str) -> WorldState:
     vec = P()
     return WorldState(tick=rep, in_group=vec, own_hb=vec,
                       known=mat, hb=mat, ts=mat,
-                      gossip=mat, joinreq=vec, joinrep=vec, rng=rep)
+                      gossip=mat, gossip_age=mat,
+                      joinreq=vec, joinrep=vec, rng=rep)
 
 
 def _sched_specs() -> Schedule:

@@ -58,6 +58,11 @@ class WorldState:
     hb: jax.Array        # i32[N, N]
     ts: jax.Array        # i32[N, N]
     gossip: jax.Array    # bool[N, N]  (sender, receiver)
+    gossip_age: jax.Array  # i32[N, N] — ticks the in-flight message has
+                           #   already waited (latency plane, worlds.py;
+                           #   all-zero and carried inert when
+                           #   link_latency == 0: every link then
+                           #   delivers after the reference's one tick)
     joinreq: jax.Array   # bool[N]
     joinrep: jax.Array   # bool[N]
     rng: jax.Array       # PRNG key
@@ -102,6 +107,14 @@ class Schedule:
     flap_period: jax.Array  # i32 scalar
     flap_down: jax.Array    # i32 scalar — down ticks per period
     flap_close: jax.Array   # i32 scalar — last tick a cycle may end at
+    byz_mask: jax.Array     # bool[N] — seeded liars (byz plane; zeros
+                            #   when off — the tick branches statically)
+    byz_target: jax.Array   # bool[N, N] — liar row i ghost-advertises
+                            #   ids j (bool[0, 0] when the plane is off)
+    byz_boost: jax.Array    # i32 scalar — relayed-heartbeat inflation
+    link_lat: jax.Array     # i32[N, N] per-link delivery delay in
+                            #   ticks (sender-major; i32[0, 0] when the
+                            #   latency plane is off)
 
     def _flap_state(self, t: jax.Array):
         """(failed, rejoining) bool[N] under the flap world: a flapper
@@ -229,6 +242,10 @@ def make_schedule_host(cfg: SimConfig) -> Schedule:
         flap_period=np.int32(max(cfg.flap_period, 1)),
         flap_down=np.int32(cfg.flap_down),
         flap_close=np.int32(flap_close if cfg.flap_rate > 0 else -1),
+        byz_mask=worlds.byz_mask_host(cfg),
+        byz_target=worlds.byz_target_host(cfg),
+        byz_boost=np.int32(cfg.byz_boost),
+        link_lat=worlds.link_latency_host(cfg),
     )
 
 
@@ -254,7 +271,9 @@ def slice_schedule(s: Schedule, a: int) -> Schedule:
         start_tick=s.start_tick[:a], fail_tick=s.fail_tick[:a],
         rejoin_tick=s.rejoin_tick[:a],
         part_group=s.part_group[:a], link_prob=s.link_prob[:a, :a],
-        flap_mask=s.flap_mask[:a], flap_phase=s.flap_phase[:a])
+        flap_mask=s.flap_mask[:a], flap_phase=s.flap_phase[:a],
+        byz_mask=s.byz_mask[:a], byz_target=s.byz_target[:a, :a],
+        link_lat=s.link_lat[:a, :a])
 
 
 
@@ -275,6 +294,7 @@ def init_state(cfg: SimConfig) -> WorldState:
         hb=jnp.zeros((n, n), jnp.int32),
         ts=jnp.zeros((n, n), jnp.int32),
         gossip=jnp.zeros((n, n), bool),
+        gossip_age=jnp.zeros((n, n), jnp.int32),
         joinreq=jnp.zeros(n, bool),
         joinrep=jnp.zeros(n, bool),
         rng=jax.random.PRNGKey(cfg.seed),
@@ -330,7 +350,8 @@ def _world_expect(host):
     n = np.asarray(host["known"]).shape[0]
     return {"tick": (), "in_group": (n,), "own_hb": (n,),
             "known": (n, n), "hb": (n, n), "ts": (n, n),
-            "gossip": (n, n), "joinreq": (n,), "joinrep": (n,)}
+            "gossip": (n, n), "gossip_age": (n, n),
+            "joinreq": (n,), "joinrep": (n,)}
 
 
 def state_to_host(state: WorldState) -> dict[str, np.ndarray]:

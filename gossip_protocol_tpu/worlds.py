@@ -55,6 +55,33 @@ The five worlds (config knobs on :class:`~.config.SimConfig`):
   ``flap_down`` ticks of every period (only cycles that complete
   before ``flap_close`` run), and every up-edge re-enters through the
   normal JOINREQ path.
+
+Round 2 adds the two planes the first five could not express — no
+world FORGED information and no link had LATENCY — plus the
+composition grammar (:func:`composition`) that makes the planes
+multiply instead of add:
+
+* **Byzantine forgery** (``byz_rate > 0``) — a hashed subset of liars
+  inflate their own heartbeat counter, relay their tables at forged
+  freshness with heartbeats boosted by ``byz_boost``, and ghost-
+  advertise a hashed quarter of the id space (fake members, removed
+  victims — the resurrection-pressure attack).  The defense compiles
+  in with the plane: liveness evidence is DIRECT-ONLY — a relayed
+  heartbeat updates the counter but never refreshes the staleness
+  timestamp, and a relayed new entry starts its staleness clock on
+  arrival — so honest detection completes on the unchanged horizon
+  and every forged entry is purged within ``t_remove + 1`` of its
+  last advertisement (the closed-form false-positive bound).
+* **per-link latency** (``link_latency > 0``) — link (i -> j)
+  delivers gossip after ``1 + H(seed, i*n+j, SALT_LAT) %
+  (link_latency + 1)`` ticks (the asym-drop construction with a delay
+  codomain).  Needs a message-age dimension in the tick: the dense
+  model ages its in-flight gossip plane (``WorldState.gossip_age``,
+  at most one message in flight per link), the overlay keeps a
+  send-history bitmask (``OverlayState.send_hist``).  Latency delays
+  the DELIVERY event; the payload rides the sender's current table
+  (the zero-copy discipline both models share), and the join path
+  stays one-tick so the segment planner's join windows are untouched.
 """
 
 from __future__ import annotations
@@ -71,6 +98,9 @@ SALT_PART = 10        # partition group assignment
 SALT_FLAP = 11        # flapping-member selection
 SALT_FLAP_PHASE = 12  # per-flapper cycle anchor
 SALT_WAVE = 13        # wave epicenter
+SALT_BYZ = 14         # Byzantine liar selection (round 2)
+SALT_BYZ_TARGET = 15  # per-liar ghost-advertisement targets
+SALT_LAT = 16         # per-link delivery delay (round 2)
 
 _U = np.uint32
 
@@ -218,3 +248,111 @@ def flap_state_host(cfg: SimConfig, i: int, t: int) -> tuple[bool, bool]:
     """One-shot ``make_flap_state`` query (re-draws the hash arrays;
     use the closure for per-tick loops)."""
     return make_flap_state(cfg)(i, t)
+
+
+# ---- round-2 planes: Byzantine forgery + per-link latency ----------
+
+#: fraction of ids each liar ghost-advertises (fixed — the knob that
+#: matters is byz_rate; a quarter of the id space keeps every receiver
+#: under sustained forged-add pressure without drowning the run)
+BYZ_TARGET_FRACTION = 0.25
+
+
+def byz_threshold(cfg: SimConfig) -> int:
+    """uint32 threshold for the liar-selection draw."""
+    return threshold32(cfg.byz_rate) if cfg.byz_rate > 0 else 0
+
+
+def byz_mask_host(cfg: SimConfig) -> np.ndarray:
+    """bool[N]: which nodes lie (introducer never — a lying join
+    authority would forge the membership ground truth itself, which is
+    a different protocol's problem; the flap/wave worlds exempt it for
+    the same reason)."""
+    n = cfg.n
+    if cfg.byz_rate <= 0:
+        return np.zeros(n, bool)
+    sel = mix32(_U(cfg.seed & 0xFFFFFFFF),
+                np.arange(n, dtype=np.uint32), _U(SALT_BYZ)) \
+        < _U(byz_threshold(cfg))
+    sel = np.asarray(sel, bool).copy()
+    sel[INTRODUCER] = False
+    return sel
+
+
+def byz_target_host(cfg: SimConfig) -> np.ndarray:
+    """bool[N, N] ghost-advertisement targets: liar row i forges
+    fresh, boosted entries for the hashed quarter of ids in row i —
+    members it may never have heard from, including removed victims
+    (the resurrection-pressure attack).  Rows of honest nodes are
+    zeroed; a bool[0, 0] placeholder when the plane is off (the tick
+    branches statically)."""
+    if cfg.byz_rate <= 0:
+        return np.zeros((0, 0), bool)
+    n = cfg.n
+    i = np.arange(n, dtype=np.uint32)
+    tgt = mix32(_U(cfg.seed & 0xFFFFFFFF),
+                i[:, None] * _U(n) + i[None, :], _U(SALT_BYZ_TARGET)) \
+        < _U(threshold32(BYZ_TARGET_FRACTION))
+    tgt = np.asarray(tgt, bool) & byz_mask_host(cfg)[:, None]
+    np.fill_diagonal(tgt, False)
+    return tgt
+
+
+def link_latency_host(cfg: SimConfig) -> np.ndarray:
+    """i32[N, N] per-link delivery delay in ticks (sender-major):
+    ``1 + H(seed, i*N+j, SALT_LAT) % (link_latency + 1)``, so every
+    link delays in [1, link_latency + 1] and the plane off means the
+    reference's uniform one-tick delivery.  An i32[0, 0] placeholder
+    when off (the tick branches statically)."""
+    if cfg.link_latency <= 0:
+        return np.zeros((0, 0), np.int32)
+    n = cfg.n
+    i = np.arange(n, dtype=np.uint32)
+    h = mix32(_U(cfg.seed & 0xFFFFFFFF),
+              i[:, None] * _U(n) + i[None, :], _U(SALT_LAT))
+    return (1 + h % _U(cfg.link_latency + 1)).astype(np.int32)
+
+
+def link_latency_of(seed, iu, ju, n: int, link_latency: int):
+    """Traced twin of :func:`link_latency_host` for the overlay's
+    per-(partner, row) lookups: ``iu``/``ju`` are uint32 id arrays
+    (sender, receiver); returns the i32 delay of each link."""
+    h = mix32(seed, iu * _U(n) + ju, _U(SALT_LAT))
+    return (1 + h % _U(link_latency + 1)).astype("int32")
+
+
+# ---- the composition grammar ---------------------------------------
+
+#: overlay planes (any subset composes; the failure SCRIPT is chosen
+#: exactly-one-of scripted | wave | churn — wave and churn both
+#: replace the scripted failure, which config validation enforces)
+PLANES = ("partition", "asym", "zombie", "flapping", "byz", "latency")
+
+
+def composition(cfg: SimConfig) -> tuple[str, tuple[str, ...]]:
+    """``(failure_script, active_planes)`` of a config — the world-
+    composition grammar in one place.  A composed world is exactly one
+    failure script (scripted single/multi fail, a correlated wave, or
+    continuous churn) with any subset of the orthogonal planes layered
+    on top; "partition opens DURING a failure wave WHILE flappers
+    flap" is one SimConfig.  Every plane's window is a seed-
+    independent config function, so compositions fold through
+    ``segments.phase_windows`` (∪ of the windows), ``worlds_key``
+    (tuple of active planes), plan signatures, bucket keys, and
+    checkpoint cuts with no per-plane special cases."""
+    script = "churn" if cfg.churn_rate > 0 \
+        else "wave" if cfg.wave_size > 0 else "scripted"
+    active = []
+    if cfg.partition_groups >= 2:
+        active.append("partition")
+    if cfg.asym_drop:
+        active.append("asym")
+    if cfg.zombie:
+        active.append("zombie")
+    if cfg.flap_rate > 0:
+        active.append("flapping")
+    if cfg.byz_rate > 0:
+        active.append("byz")
+    if cfg.link_latency > 0:
+        active.append("latency")
+    return script, tuple(active)

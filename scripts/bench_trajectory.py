@@ -131,6 +131,8 @@ def load_rows():
             "load_deterministic": _get(load, "replay_check",
                                        "deterministic"),
             "scenario_variants": scen.get("variants"),
+            "scenario_families": scen.get("families"),
+            "scenario_worlds": scen.get("worlds"),
             "scenario_pass_rate": scen.get("oracle_pass_rate"),
             "scenario_replayed": scen.get("replayed_digest_for_digest"),
             "recovery_completion": recov.get("completion_rate"),
@@ -176,6 +178,7 @@ def main(argv) -> int:
             ("load rps", "load_max_achieved_rps", "{:.1f}"),
             ("sat rps", "load_saturation_rps", "{:.1f}"),
             ("scen", "scenario_variants", "{}"),
+            ("worlds", "scenario_worlds", "{}"),
             ("scen ok", "scenario_pass_rate", "{:.0%}"),
             ("recov", "recovery_completion", "{:.0%}"),
             ("spill MB", "recovery_spill_mb", "{:.1f}"),

@@ -13,7 +13,8 @@ Modes:
     python scripts/service_smoke.py pipeline [34]     # pipelined vs sync per D
     python scripts/service_smoke.py load [24]         # open-loop 3-seed sweep
     python scripts/service_smoke.py elastic [34] [48] # loss+return legs sweep
-    python scripts/service_smoke.py scenarios [20]    # adversarial-world sweep
+    python scripts/service_smoke.py scenarios [40]    # adversarial-world sweep
+    python scripts/service_smoke.py scenarios 40 --composed  # round-2 worlds only
     python scripts/service_smoke.py scenario --family F --seed S  # 1 repro
     python scripts/service_smoke.py recover [34] [48] # kill/restart sweep
     python scripts/service_smoke.py inspect RUN_DIR DIGEST  # verify 1 spill
@@ -46,11 +47,13 @@ uninterrupted baseline run.  ``inspect`` verifies a single spilled
 snapshot (readable -> array sha -> content digest) WITHOUT importing
 jax — it is the repro command a CheckpointValidationError prints.
 
-``scenarios`` (PR 9) is the scenario-frontier acceptance run
-(docs/SCENARIOS.md): the full adversarial-world catalog
-(models/scenarios.py — partitions that heal, asymmetric per-link
-loss, correlated failure waves, zombie peers, flapping members; both
-models) x ``seeds_per_family`` seeds, graded as ONE FleetService run
+``scenarios`` (PR 9, round 2 in PR 15) is the scenario-frontier
+acceptance run (docs/SCENARIOS.md): the full adversarial-world
+catalog (models/scenarios.py — partitions that heal, asymmetric
+per-link loss, correlated failure waves, zombie peers, flapping
+members, Byzantine liars, per-link latency, and composed storms that
+stack several planes at once; both models) x ``seeds_per_family``
+seeds, graded as ONE FleetService run
 with every variant's closed-form oracle verdict recorded.  Gates
 (enforced inside scenarios.sweep + here): 100% of variants terminal,
 every oracle green, and the whole sweep re-run digest-for-digest
@@ -58,7 +61,10 @@ every oracle green, and the whole sweep re-run digest-for-digest
 reproduce identical worlds.  A failing variant prints its exact
 single-variant repro, which is the ``scenario`` mode:
 ``scenario --family dense_wave --seed 1007`` re-runs one variant solo
-(no service) and prints its verdict + lane digest.
+(no service) and prints its verdict + lane digest.  ``--composed``
+restricts the catalog to the round-2 frontier (the byz / latency /
+composed worlds) for a faster targeted pass with a matching
+lower acceptance floor.
 
 ``load`` (PR 7) exercises the open-loop traffic plane
 (service/traffic.py + service/slo.py + service/loadbench.py): for
@@ -426,12 +432,23 @@ def main(argv) -> int:
         return 0
     elif mode == "scenarios":
         from gossip_protocol_tpu.models import scenarios
-        seeds = int(argv[1]) if len(argv) > 1 else 20
-        n_fam = len(scenarios.CATALOG)
-        print(f"scenario sweep: {n_fam} families x {seeds} seeds = "
+        composed = "--composed" in argv[1:]
+        rest = [a for a in argv[1:] if a != "--composed"]
+        seeds = int(rest[0]) if rest else 40
+        fams = sorted(scenarios.CATALOG)
+        if composed:
+            # the round-2 frontier only: byz / latency planes and the
+            # composed storms (worlds.composition)
+            fams = [f for f in fams
+                    if scenarios.CATALOG[f].world
+                    in ("byz", "latency", "composed")]
+        n_fam = len(fams)
+        floor = 200 if composed else 1000
+        print(f"scenario sweep{' (composed frontier)' if composed else ''}: "
+              f"{n_fam} families x {seeds} seeds = "
               f"{n_fam * seeds} variants, one FleetService run",
               flush=True)
-        r = scenarios.sweep(seeds_per_family=seeds)
+        r = scenarios.sweep(families=fams, seeds_per_family=seeds)
         for name in sorted(r["per_family"]):
             pf = r["per_family"][name]
             print(f"  {name:26s} pass {pf['pass']:3d} / "
@@ -440,13 +457,14 @@ def main(argv) -> int:
         print(f"{r['variants']} variants in {r['wall_s']:.1f}s, "
               f"{r['dispatches']} dispatches over {r['buckets']} buckets, "
               f"occupancy {r['mean_occupancy']:.2f}", flush=True)
-        r2 = scenarios.sweep(seeds_per_family=seeds)
+        r2 = scenarios.sweep(families=fams, seeds_per_family=seeds)
         reproduced = (r2["verdict_digest"] == r["verdict_digest"]
                       and r2["outcome_digest"] == r["outcome_digest"])
         ok = (r["pass_rate"] == 1.0 and r["terminal_rate"] == 1.0
               and reproduced)
         print(f"acceptance: {r['variants']} variants "
-              f"{'OK' if r['variants'] >= 200 else 'FAIL'} (>=200), "
+              f"{'OK' if r['variants'] >= floor else 'FAIL'} "
+              f"(>={floor}), "
               f"100% terminal OK (enforced), oracle pass rate "
               f"{r['pass_rate']:.4f} "
               f"{'OK' if r['pass_rate'] == 1.0 else 'FAIL'}, "

@@ -646,6 +646,31 @@ def make_fixture_run(cfg):
     assert missing["drop_open_tick"] == fixture["drop_open_tick"]
 
 
+def test_round2_world_fields_are_covered_by_name():
+    """The round-2 planes' knobs (byz_rate / byz_boost / link_latency)
+    are key-folded (worlds_key appends them only when active —
+    config.py), so a composed-world config can never be served a
+    cached honest/delay-free program.  byz_rate and link_latency are
+    also read directly by builders — the pin: strip one from the
+    covered set and the diff must fail naming it.  byz_boost reaches
+    the tick only THROUGH the Schedule arrays (sched.byz_boost), so
+    it legitimately has no builder read — its coverage is the
+    key+data side alone.  A silent pass here would mean the scanner
+    stopped seeing the reads and the gate went blind to the planes."""
+    builders = cache_keys.builder_fields()
+    covered = cache_keys.covered_fields()
+    for fld in ("byz_rate", "byz_boost", "link_latency"):
+        assert fld in covered, f"{fld} not key-folded"
+    for fld in ("byz_rate", "link_latency"):
+        assert fld in builders, f"builder scan lost {fld}"
+        missing = cache_keys.missing_fields(
+            builders=builders, covered=covered - {fld})
+        assert fld in missing, f"diff went blind to {fld}"
+        assert missing[fld], f"no builder locations reported for {fld}"
+    assert "byz_boost" not in builders, \
+        "byz_boost grew a direct builder read: add it to the diff pin"
+
+
 # ---- runtime guards --------------------------------------------------
 def test_compile_counter_counts_and_budget_trips():
     f = jax.jit(lambda x: x * 5 + 2)

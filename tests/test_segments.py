@@ -266,3 +266,90 @@ def test_world_checkpoint_cuts_are_seed_shared():
     # LIVE tick, so its segment runs through the covering launch and
     # the post-partition steady segment starts at 112
     assert 48 in cuts and 112 in cuts
+
+
+# ---- composed worlds (worlds.composition, round 2) --------------------
+
+def _composed_cfg(**kw):
+    """"Partition DURING failure wave WHILE flappers flap" as ONE
+    config — the composition-grammar sentence from docs/SCENARIOS.md."""
+    base = dict(single_failure=False, wave_size=9, wave_tick=70,
+                wave_speed=2, rejoin_after=20,
+                flap_rate=0.3, flap_period=24, flap_down=6,
+                flap_open_tick=50, flap_close_tick=120,
+                partition_groups=2, partition_open_tick=30,
+                partition_close_tick=80)
+    base.update(kw)
+    return _world_cfg(**base)
+
+
+def test_composed_windows_are_the_union_of_the_planes():
+    """Each plane folds onto its own window axis and overlapping
+    windows ∪-fold: the composed config's windows are exactly the
+    pointwise union of the single-plane runs."""
+    from gossip_protocol_tpu.models.segments import checkpoint_ticks
+    cfg = _composed_cfg()
+    win = phase_windows(cfg)
+    wave = phase_windows(_world_cfg(single_failure=False, wave_size=9,
+                                    wave_tick=70, wave_speed=2,
+                                    rejoin_after=20))
+    flap = phase_windows(_world_cfg(flap_rate=0.3, flap_period=24,
+                                    flap_down=6, flap_open_tick=50,
+                                    flap_close_tick=120,
+                                    fail_tick=10_000))
+    part = phase_windows(_world_cfg(partition_groups=2,
+                                    partition_open_tick=30,
+                                    partition_close_tick=80))
+    # churn = wave ∪ flap: the flap opens first (50 + 1 = 51), the
+    # flap closes last (120 > wave's 74 + 20 = 94).  The flap-only
+    # baseline can't anchor the rejoin axis — its out-of-horizon
+    # scripted failure is permanent, so it reports an infinite
+    # rejoin_hi — but composing with the wave (finite rejoin) folds
+    # the flap close tick in exactly.
+    assert win.fail_lo == min(wave.fail_lo, flap.fail_lo) == 51
+    assert win.rejoin_hi == 120 and wave.rejoin_hi == 94
+    assert win.join_dead_from == flap.join_dead_from == 123
+    # drop = the partition alone (the drop world is off)
+    assert (win.drop_lo, win.drop_hi) == (part.drop_lo, part.drop_hi) \
+        == (31, 80)
+    # all three phases are simultaneously live mid-storm
+    f = flags_at(win, 72)
+    assert f.churn_live and f.drop_live and f.join_live
+
+
+def test_windowless_planes_rebucket_without_moving_windows():
+    """BYZ and LATENCY have no window of their own — they must leave
+    phase elision untouched while still changing plan identity (via
+    worlds_key), so a liar config can never be served a kernel plan
+    compiled for the honest one."""
+    from gossip_protocol_tpu.models.segments import plan_signature
+    cfg = _composed_cfg()
+    byz = cfg.replace(byz_rate=0.25)
+    lat = cfg.replace(link_latency=4)
+    assert phase_windows(byz) == phase_windows(cfg)
+    assert phase_windows(lat) == phase_windows(cfg)
+    sigs = [plan_signature(c) for c in
+            (cfg, byz, lat, byz.replace(byz_boost=16),
+             lat.replace(link_latency=6), byz.replace(link_latency=4))]
+    assert len(set(sigs)) == len(sigs)
+    assert plan_signature(byz) == plan_signature(byz.replace(seed=77))
+
+
+def test_composed_checkpoint_cuts_resume_to_the_plan_tail():
+    """Cuts of the composed plan are seed-shared, launch-aligned, sit
+    only where the live-phase mix actually changes (never inside an
+    elided steady phase), and resuming at any cut replays the original
+    plan's tail exactly — the static-elision invariant checkpointing
+    relies on."""
+    from gossip_protocol_tpu.models.segments import checkpoint_ticks
+    cfg = _composed_cfg(link_latency=4, byz_rate=0.1)
+    cuts = checkpoint_ticks(cfg)
+    assert cuts, "composed plan offered no interior cuts"
+    assert cuts == checkpoint_ticks(cfg.replace(seed=123))
+    assert all(c % 16 == 0 for c in cuts)
+    full = plan_segments(cfg, cfg.total_ticks, 0, 16)
+    for a, b in zip(full, full[1:]):
+        assert a.flags != b.flags      # a cut always changes the mix
+    for c in cuts:
+        tail = [s for s in full if s.start >= c]
+        assert plan_segments(cfg, cfg.total_ticks - c, c, 16) == tail
