@@ -757,6 +757,21 @@ def main():
             "env": _env_provenance(),
         }
 
+        # compile-surface budget (PR 16, docs/PERF.md §12): the
+        # scenario grammar jittered per request (off-rung n, off-grid
+        # windows, perturbed world params) through a baseline exact-
+        # bucket lap vs cold + warm CANONICAL laps
+        # (service/canonical.py).  compile_surface_bench raises unless
+        # every request is bit-identical to its exact-bucket result
+        # (plus a direct-solo sample), the warm lap builds NOTHING,
+        # and (full runs) fresh builds collapse >= 3x — this entry
+        # existing IS the compile-surface gate.
+        from gossip_protocol_tpu.service.loadbench import \
+            compile_surface_bench
+        cs = compile_surface_bench(smoke=smoke)
+        cs["env"] = _env_provenance()
+        secondary["compile_surface"] = cs
+
     secondary.update({
         f"n{n_drop}_overlay_drop10": _overlay_entry(drop, backend),
         f"n{n_dense}_fullview": _entry(dense_cfg, dense, backend),
@@ -821,7 +836,55 @@ def main():
         rc_compiles = check_steady_state_compiles(
             inject="--inject-recompile" in sys.argv)
         rc_lint = check_static_analysis(payload["analysis"])
+        # record the row AFTER the gates ran (so the regression gate
+        # compared against the PREVIOUS baseline, not this run) but
+        # UNCONDITIONALLY — PR 14 and 15 gated without recording,
+        # leaving a two-PR hole in the trajectory.  A write failure is
+        # a hard failure: an unrecordable gate run must not pass.
+        write_bench_row(payload)
         sys.exit(rc or rc_compiles or rc_lint)
+
+
+def _pr_number() -> int:
+    """The PR number this run records under: ``--pr N`` wins; else one
+    past the highest PR mentioned in CHANGES.md (the stacked-PR
+    trajectory convention), falling back to the highest existing
+    BENCH_pr*.json."""
+    import glob
+    import re
+    for i, a in enumerate(sys.argv):
+        if a == "--pr" and i + 1 < len(sys.argv):
+            return int(sys.argv[i + 1])
+        if a.startswith("--pr="):
+            return int(a.split("=", 1)[1])
+    root = os.path.dirname(os.path.abspath(__file__))
+    prs: list = []
+    try:
+        with open(os.path.join(root, "CHANGES.md")) as f:
+            prs = [int(m) for m in re.findall(r"\bPR (\d+)", f.read())]
+    except OSError:
+        pass
+    if not prs:
+        prs = [int(re.search(r"BENCH_pr(\d+)", p).group(1))
+               for p in glob.glob(os.path.join(root, "BENCH_pr*.json"))]
+    return (max(prs) if prs else 0) + 1
+
+
+def write_bench_row(payload: dict) -> str:
+    """Record this --check run as ``BENCH_pr<N>.json`` — every gate
+    run leaves a trajectory row, pass or fail.  Atomic (tmp +
+    replace); any write error PROPAGATES — silently losing the row is
+    exactly the PR-14/15 hole this exists to close."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(root, f"BENCH_pr{_pr_number():02d}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.write("\n")
+    os.replace(tmp, path)
+    print(f"bench --check: recorded {os.path.basename(path)}",
+          file=sys.stderr)
+    return path
 
 
 #: --check fails the run when the fresh headline falls more than this

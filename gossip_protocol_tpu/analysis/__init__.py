@@ -113,6 +113,13 @@ RULES: tuple[RuleInfo, ...] = (
              "into its compile-cache/bucket key or flows through the "
              "Schedule arrays as data",
              "PR 1/3 (plan-signature cache keys; stale-program class)"),
+    RuleInfo("canon-key-complete", "ast",
+             "every SimConfig field a canonical-path builder reads is "
+             "folded into the equivalence-class key (ladder rung, "
+             "quantized signature, world split) or rides the padded "
+             "Schedule/world planes as per-request data",
+             "PR 16 (bucket canonicalization: one program per class "
+             "must stay bit-identical per member)"),
     RuleInfo("lanes-axis-zero-collectives", "sharding",
              "no collective runs over a zero-collective (lane) axis "
              "of a mesh program — the axis-aware successor of "
@@ -183,9 +190,11 @@ def run_all(passes=("jaxpr", "sharding", "ast"), rules=None) -> list[Finding]:
     if "ast" in passes:
         from . import purity_lint
         findings += purity_lint.lint(rules=rules)
-        if rules is None or "cache-key-complete" in rules:
+        if rules is None or {"cache-key-complete",
+                             "canon-key-complete"} & set(rules):
             from . import cache_keys
-            findings += cache_keys.check()
+            findings += [f for f in cache_keys.check()
+                         if rules is None or f.rule in rules]
     if "guard" in passes:
         from . import guards
         findings += guards.self_check(rules=rules)

@@ -63,7 +63,9 @@ BUILDER_FUNCS = {
         "_shared_drop", "fleet_shape_key", "_dense_bench_fn",
         "_dense_trace_fn", "launch", "launch_bench", "launch_leg",
         "_overlay_launch", "_overlay_leg_launch",
-        "_dense_trace_leg_launch", "_overlay_fleet_fn", "_lane_cfgs"),
+        "_dense_trace_leg_launch", "_overlay_fleet_fn", "_lane_cfgs",
+        "_canon_run_builder", "_stack_scheds_canon",
+        "_canon_trace_lanes"),
     "gossip_protocol_tpu/models/overlay.py": (
         "make_overlay_run", "make_overlay_tick",
         "make_overlay_fleet_run"),
@@ -85,11 +87,37 @@ KEY_FUNCS = {
     "gossip_protocol_tpu/core/dense_corner.py": ("active_bound",),
 }
 
+#: the CANONICAL key tier (PR 16, service/canonical.py): what the
+#: equivalence-class key folds in — the pad-ladder rung over n, the
+#: quantized plan signature, and the operand-vs-static world split.
+#: Kept SEPARATE from KEY_FUNCS: a field only the canonical key reads
+#: must not count as covered for the exact-bucket soundness set.
+CANON_KEY_FUNCS = {
+    "gossip_protocol_tpu/service/canonical.py": (
+        "canonical_bucket_key", "canonical_fleet_shape_key",
+        "canonical_supported", "ladder_rung", "canonical_drop_window",
+        "canonical_drop_active"),
+    "gossip_protocol_tpu/models/segments.py": (
+        "quantized_plan_signature", "quantize_tick"),
+    "gossip_protocol_tpu/worlds.py": ("canonical_world_key",),
+}
+
+#: what the canonical program actually BAKES: the shared tick builder
+#: plus the canonical fleet's own staging/slicing.  The canonical
+#: soundness set is the same shape as the exact one:
+#: fields_read(canon builders) ⊆ fields_read(canon keys) ∪ data.
+CANON_BUILDER_FUNCS = {
+    "gossip_protocol_tpu/core/tick.py": ("make_tick",),
+    "gossip_protocol_tpu/core/fleet.py": (
+        "_canon_run_builder", "_stack_scheds_canon",
+        "_canon_trace_lanes"),
+}
+
 #: functions whose reads flow through the Schedule arrays as DATA
 DATA_FUNCS = {
     "gossip_protocol_tpu/state.py": (
         "make_schedule_host", "make_schedule", "init_state",
-        "slice_schedule"),
+        "slice_schedule", "pad_schedule_host"),
     "gossip_protocol_tpu/models/overlay.py": (
         "make_overlay_schedule", "resolved_dims",
         "degree_thresholds", "init_overlay_state"),
@@ -192,6 +220,33 @@ def covered_fields() -> set:
     return covered
 
 
+def canonical_builder_fields() -> dict:
+    return fields_read(CANON_BUILDER_FUNCS)
+
+
+def canonical_covered_fields() -> set:
+    """Fields safe under the canonical equivalence-class key: folded
+    into the canonical key itself (which reads the ladder rung, the
+    quantized signature, and the world split), or riding the padded
+    Schedule arrays / world planes as per-request DATA — exact
+    windows, drop realizations, and runtime world operands all travel
+    that second way by design."""
+    covered = set(fields_read(CANON_KEY_FUNCS))
+    covered |= set(fields_read(DATA_FUNCS, whole_modules=DATA_MODULES))
+    covered.add("seed")
+    return covered
+
+
+def canonical_missing_fields(builders: dict | None = None,
+                             covered: set | None = None) -> dict:
+    """``{field: [builder locations]}`` read by the canonical-path
+    builders but neither canonical-key-folded nor schedule data."""
+    builders = canonical_builder_fields() if builders is None else builders
+    covered = canonical_covered_fields() if covered is None else covered
+    return {f: locs for f, locs in sorted(builders.items())
+            if f not in covered}
+
+
 def missing_fields(builders: dict | None = None,
                    covered: set | None = None) -> dict:
     """``{field: [builder locations]}`` read by builders but neither
@@ -220,4 +275,14 @@ def check() -> list[Finding]:
             "worlds_key / bucket_key) and is not schedule data — two "
             f"configs differing only in {fld!r} can be served one "
             f"stale program (all readers: {', '.join(sorted(set(locs)))})"))
+    for fld, locs in canonical_missing_fields().items():
+        findings.append(Finding(
+            "canon-key-complete", locs[0],
+            f"SimConfig.{fld} is read by a canonical-path builder but "
+            "folded into NO canonical key component "
+            "(canonical_fleet_shape_key / quantized_plan_signature / "
+            "canonical_world_key) and is not schedule data — two "
+            f"requests differing only in {fld!r} can land in one "
+            "equivalence class and share one stale canonical program "
+            f"(all readers: {', '.join(sorted(set(locs)))})"))
     return findings

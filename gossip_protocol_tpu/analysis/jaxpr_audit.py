@@ -262,6 +262,42 @@ def build_programs(mesh_devices: int = 2) -> list[AuditedProgram]:
         rules=("cond-stays-cond", "zero-collectives-per-tick",
                "donation-taken", "no-transfer-in-scan")))
 
+    # ---- canonical fleet (PR 16: pad-ladder + quantized window) ----
+    # The equivalence-class program: non-power-of-two members padded
+    # to the rung, the SHARED quantized superset drop window riding
+    # unbatched (SCHED_AXES_CANON).  The twin batches the drop plane —
+    # the shared build must keep strictly more real conds, proving
+    # the quantized window did not degrade the drop cond to select_n
+    # and the world operands stayed traced data (zero extra bakes).
+    import numpy as np
+
+    from ..core.fleet import CanonicalFleetSimulation, _stack_scheds
+    from ..state import make_schedule_host, pad_schedule_host
+    ncfg = dcfg.replace(max_nnb=10)
+    cs = CanonicalFleetSimulation(ncfg)
+    ccfgs = [ncfg.replace(seed=s) for s in (1, 2)]
+    cscheds = [pad_schedule_host(make_schedule_host(c), cs.rung)
+               for c in ccfgs]
+    cstates = cs._dense_init_stacked(cs.cfg, 2)(
+        np.asarray([c.seed for c in ccfgs], np.int64))
+    cargs = (cstates, cs._stack_scheds_canon(cscheds))
+    cargs_b = (cstates, _stack_scheds(cscheds, False))
+    ncrun = cs._canon_run_builder(ncfg.total_ticks)
+    ncjx = jax.make_jaxpr(ncrun)(*cargs)
+    nctwin = jax.make_jaxpr(
+        cs._canon_run_builder(ncfg.total_ticks, batched_drop=True))(
+        *cargs_b)
+    nclow = jax.jit(ncrun, donate_argnums=(0,)).lower(*cargs)
+    progs.append(AuditedProgram(
+        name="fleet-dense-canonical",
+        provenance=_provenance(
+            CanonicalFleetSimulation._canon_run_builder),
+        jaxpr=ncjx, twin=nctwin, min_cond=1, lowered=nclow,
+        notes=f"n={ncfg.n} padded to rung {cs.rung}; shared "
+              "quantized window vs batched-drop twin",
+        rules=("cond-stays-cond", "zero-collectives-per-tick",
+               "donation-taken", "no-transfer-in-scan")))
+
     # ---- fleet overlay (vmap with the shared clock) ----------------
     ofrun = make_overlay_fleet_run(ocfg, 2, use_pallas=False)
     ofargs = _overlay_fleet_args(ocfg)

@@ -250,7 +250,16 @@ class SimConfig:
         course worlds; folded into the dense fleet shape key, the
         run-cache keys, and the kernel support gates (the Pallas
         mega/grid kernels do not compile the new worlds — world
-        configs take the XLA paths)."""
+        configs take the XLA paths).
+
+        This is the EXACT key: it pins every world parameter, which
+        is what the solo run cache and checkpoint-leg validation
+        need.  The serving layer's canonical tier keeps only the
+        plane TAGS and moves the parameters to runtime operands
+        (worlds.canonical_world_key / OPERAND_WORLD_FIELDS, PR 16) —
+        a change here must be mirrored there or the canonical
+        completeness pass (``canon-key-complete``) will name the
+        uncovered field."""
         ws = []
         if self.partition_groups >= 2:
             ws.append(("part", self.partition_groups,

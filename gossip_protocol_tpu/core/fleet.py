@@ -53,7 +53,7 @@ import numpy as np
 
 from ..config import SimConfig
 from ..state import (Schedule, WorldState, init_state,
-                     make_schedule_host)
+                     make_schedule_host, pad_schedule_host)
 from .sim import SimResult, _finish_masks_host, _pack_sparse
 from .tick import TickEvents, make_tick
 
@@ -77,6 +77,11 @@ EVENT_AXES = TickEvents(added=0, removed=0, sent=0, recv=0)
 SCHED_AXES_SHARED_DROP = Schedule(start_tick=0, fail_tick=0,
                                   rejoin_tick=0, drop_active=None,
                                   drop_prob=None,
+                                  # exact-window scalars are inert on
+                                  # this path (lane_drop_window off)
+                                  # and a shared-drop bucket agrees on
+                                  # them anyway
+                                  drop_open=None, drop_close=None,
                                   # the partition WINDOW rides the
                                   # shared plane (window scalars are
                                   # config values the whole bucket
@@ -92,11 +97,30 @@ SCHED_AXES_SHARED_DROP = Schedule(start_tick=0, fail_tick=0,
                                   link_lat=0)
 SCHED_AXES_BATCHED = Schedule(start_tick=0, fail_tick=0, rejoin_tick=0,
                               drop_active=0, drop_prob=0,
+                              drop_open=0, drop_close=0,
                               part_group=0, part_on=0, part_open=0,
                               part_close=0, link_prob=0, flap_mask=0,
                               flap_phase=0, flap_period=0, flap_down=0,
                               flap_close=0, byz_mask=0, byz_target=0,
                               byz_boost=0, link_lat=0)
+#: Canonical-bucket axes (service/canonical.py): lanes of ONE
+#: equivalence class share the QUANTIZED superset drop window as the
+#: unbatched cond predicate — exactly like SHARED_DROP keeps the draw
+#: cond a real cond — while everything the class treats as a runtime
+#: operand stays per-lane: drop probability, the EXACT window scalars
+#: (re-applied by make_tick ``lane_drop_window``), the partition
+#: window, byz_boost, the link matrices.  vmap keeps a cond whose
+#: PREDICATE is unbatched a real cond even when branch operands are
+#: batched, which is what makes per-lane drop_prob free here
+#: (pinned by analysis/jaxpr_audit.py "fleet-dense-canonical").
+SCHED_AXES_CANON = Schedule(start_tick=0, fail_tick=0, rejoin_tick=0,
+                            drop_active=None, drop_prob=0,
+                            drop_open=0, drop_close=0,
+                            part_group=0, part_on=0, part_open=0,
+                            part_close=0, link_prob=0, flap_mask=0,
+                            flap_phase=0, flap_period=0, flap_down=0,
+                            flap_close=0, byz_mask=0, byz_target=0,
+                            byz_boost=0, link_lat=0)
 
 
 def _shared_drop(cfgs) -> bool:
@@ -232,6 +256,22 @@ def _embed_state_host(state_a, n: int):
         ts=plane(state_a.ts), gossip=plane(state_a.gossip),
         gossip_age=plane(state_a.gossip_age),
         joinreq=vec(state_a.joinreq), joinrep=vec(state_a.joinrep))
+
+
+def _slice_state_host(state, n: int):
+    """Inverse of :func:`_embed_state_host`: the real ``n x n`` corner
+    of a rung-width state (host numpy views).  The canonical fleet
+    path (service/canonical.py) runs lanes at their pad-ladder rung
+    and hands back real-width results only — filler peers' rows are
+    identically zero by the inert-schedule construction and are never
+    surfaced."""
+    return WorldState(
+        tick=state.tick, rng=state.rng,
+        in_group=state.in_group[:n], own_hb=state.own_hb[:n],
+        known=state.known[:n, :n], hb=state.hb[:n, :n],
+        ts=state.ts[:n, :n], gossip=state.gossip[:n, :n],
+        gossip_age=state.gossip_age[:n, :n],
+        joinreq=state.joinreq[:n], joinrep=state.joinrep[:n])
 
 
 def _lane_state(states, i: int):
@@ -1746,3 +1786,248 @@ class FleetSimulation:
         if not defer:
             pending.start()
         return pending
+
+class CanonicalFleetSimulation(FleetSimulation):
+    """A fleet over one CANONICAL equivalence class (service/canonical
+    .py): lanes whose exact configs differ — peer count below the same
+    pad-ladder rung (drop-off classes), drop probability, phase-window
+    jitter within the quantization grid, world operand values — ride
+    ONE compiled program at the rung width.
+
+    Mechanically this is the base dense fleet with ``self.cfg`` set to
+    a RUNG-WIDTH representative (``member.replace(max_nnb=rung)``), so
+    every inherited piece of machinery — the batched init, the event
+    compaction, chunk budgeting — naturally operates at rung width.
+    The canonical deltas are confined to:
+
+    * lane validation by canonical key equality (not exact shape);
+    * schedule staging: each lane's REAL-width schedule is padded to
+      the rung with inert filler peers (state.pad_schedule_host) and
+      the stacked ``drop_active`` is replaced by the class's shared
+      QUANTIZED superset window (canonical_drop_active), with per-lane
+      exact windows re-applied in the tick (make_tick
+      ``lane_drop_window``) — the SCHED_AXES_CANON split;
+    * the drop stream is drawn at the class's ``stream_n`` (the REAL
+      peer count of drop-on classes) and corner-embedded, so padded
+      lanes consume the byte-identical Bernoulli stream;
+    * results are sliced back to each lane's real ``n`` host-side —
+      filler PEERS, like filler lanes, are never unstacked.
+
+    Per-lane results are bit-identical to exact unpadded solo runs
+    (tests/test_canonical.py pins this per tick).  Monolithic trace
+    dispatches only: bench mode bakes the active corner and checkpoint
+    legs validate exact-plan cuts, so both keep exact buckets
+    (canonical_supported routes them away before construction).
+    """
+
+    def __init__(self, cfg: SimConfig, block_size: int = 128,
+                 chunk_ticks: Optional[int] = None):
+        from ..service.canonical import (canonical_bucket_key,
+                                         canonical_supported,
+                                         ladder_rung)
+        if not canonical_supported(cfg, "trace"):
+            raise ValueError(
+                f"config (model={cfg.model!r}) is not canonicalizable; "
+                "use FleetSimulation with the exact bucket key")
+        self.member_cfg = cfg
+        self.rung = ladder_rung(cfg.n)
+        self._canon_key = canonical_bucket_key(cfg, "trace")
+        # the class's drop-stream width: real n for drop-on classes
+        # (stream bit-identity pins it), None otherwise — mirrors the
+        # stream_n component of canonical_fleet_shape_key
+        self._stream_n = cfg.n if (cfg.drop_msg or cfg.asym_drop) \
+            else None
+        self._lane_drop = self._stream_n is not None
+        super().__init__(cfg.replace(max_nnb=self.rung),
+                         block_size=block_size, chunk_ticks=chunk_ticks)
+
+    # ---- canonical lane validation ----------------------------------
+    def _lane_cfgs(self, seeds, configs) -> list[SimConfig]:
+        from ..service.canonical import canonical_bucket_key
+        if (seeds is None) == (configs is None):
+            raise ValueError("pass exactly one of seeds= or configs=")
+        if configs is None:
+            configs = [self.member_cfg.replace(seed=int(s))
+                       for s in seeds]
+        configs = list(configs)
+        if not configs:
+            raise ValueError("empty fleet")
+        for i, c in enumerate(configs):
+            k = canonical_bucket_key(c, "trace")
+            if k != self._canon_key:
+                raise ValueError(
+                    f"lane {i} is not a member of this canonical "
+                    f"equivalence class: {k} != {self._canon_key}")
+        return configs
+
+    def _key_prefix(self) -> tuple:
+        # the canonical key IS the program identity (rung, stream_n,
+        # static plane set, quantized plan) — exact member keys must
+        # NOT enter, or the collapse would silently vanish
+        return (self._canon_key, self.block_size, self._mesh_entry())
+
+    # ---- canonical program ------------------------------------------
+    def _canon_run_builder(self, length: int, batched_drop: bool = False):
+        """UNJITTED canonical run builder (shared by the cached
+        program below and the jaxpr audit, which also builds the
+        ``batched_drop`` twin to prove the shared quantized window
+        keeps strictly more real conds)."""
+        na = self._stream_n if self._stream_n is not None else self.cfg.n
+        tick = make_tick(self.cfg, self.block_size, use_pallas=False,
+                         with_events=True, n_active=na,
+                         lane_drop_window=self._lane_drop)
+        axes = SCHED_AXES_BATCHED if batched_drop else SCHED_AXES_CANON
+        vtick = jax.vmap(tick, in_axes=(WORLD_AXES, axes),
+                         out_axes=(WORLD_AXES, EVENT_AXES))
+
+        def run(states: WorldState, scheds: Schedule):
+            def step(carry, _):
+                return vtick(carry, scheds)
+            return jax.lax.scan(step, states, None, length=length)
+
+        return run
+
+    def _canon_trace_fn(self, batch: int, length: int):
+        def build():
+            return partial(jax.jit, donate_argnums=(0,))(
+                self._canon_run_builder(length))
+        return self._fleet_program(
+            self._cache_key("canon-trace", batch, length), build)
+
+    def _stack_scheds_canon(self, scheds):
+        """Stack rung-padded lane schedules host-side; the shared
+        drop plane is the class's quantized superset window (a pure
+        function of the canonical key, so every member agrees)."""
+        from ..service.canonical import canonical_drop_active
+        st = stack_lanes_host(scheds)
+        return st.replace(
+            drop_active=canonical_drop_active(self.member_cfg))
+
+    def _canon_trace_lanes(self, cfgs, scheds, final_h, nr,
+                           added, removed, sent, recv):
+        """Per-lane results sliced to each lane's REAL peer count —
+        the pad-ladder twin of :meth:`_dense_trace_lanes`.  Filler
+        peers (rows >= lane n) are never surfaced, mirroring the
+        filler-LANE invariant (:func:`_check_unstacked`)."""
+        lanes = []
+        for i, (c, s) in enumerate(zip(cfgs[:nr], scheds[:nr])):
+            n = c.n
+            lanes.append(SimResult(
+                cfg=c,
+                start_tick=np.asarray(s.start_tick[:n]),
+                fail_tick=np.asarray(s.fail_tick[:n]),
+                rejoin_tick=np.asarray(s.rejoin_tick[:n]),
+                added=np.concatenate(
+                    [ch[:, i, :n, :n] for ch in added], 0),
+                removed=np.concatenate(
+                    [ch[:, i, :n, :n] for ch in removed], 0),
+                sent=np.concatenate(
+                    [ch[:, i, :n] for ch in sent], 0).T.copy(),
+                recv=np.concatenate(
+                    [ch[:, i, :n] for ch in recv], 0).T.copy(),
+                final_state=_slice_state_host(_lane_state(final_h, i), n),
+                wall_seconds=0.0))
+        _check_unstacked(lanes, nr)
+        return lanes
+
+    def launch(self, seeds=None, configs=None,
+               n_real: Optional[int] = None,
+               warmup: bool = True, defer: bool = False) -> PendingFleet:
+        """Monolithic canonical dense trace launch: the base
+        single-segment async path at rung width over padded lanes."""
+        cfgs = self._lane_cfgs(seeds, configs)
+        nr = self._resolve_n_real(len(cfgs), n_real)
+        b = len(cfgs)
+        total = self.cfg.total_ticks
+        per_tick = 2 * self.cfg.n * self.cfg.n * b
+        if total * per_tick > (1 << 30):
+            # the canonical path has no chunked fallback by design
+            # (chunk boundaries would need exact-plan cut validation);
+            # classes this large keep exact buckets
+            raise ValueError(
+                f"canonical trace event budget exceeded (rung="
+                f"{self.cfg.n}, b={b}, ticks={total}); serve this "
+                "config through the exact bucket path")
+        init = self._dense_init_stacked(self.cfg, b)
+        seeds_v = np.asarray([c.seed for c in cfgs], np.int64)
+        t0 = time.perf_counter()
+        scheds = [pad_schedule_host(make_schedule_host(c), self.rung)
+                  for c in cfgs]
+        sscheds = self._stack_scheds_canon(scheds)
+        states0 = init(seeds_v)
+        run = self._canon_trace_fn(b, total)
+        stage_s = time.perf_counter() - t0
+        box: dict = {}
+
+        def start():
+            t_s0 = time.perf_counter()
+            states, ev = run(states0, sscheds)
+            box["out"] = (states,
+                          self._dense_trace_stage_device(ev, total, nr))
+            box["held"] = _pop_held(run)
+            box["t_launch"] = time.perf_counter()
+            box["pack"] = stage_s + (box["t_launch"] - t_s0)
+
+        def wait():
+            if "t_ready" not in box:
+                jax.block_until_ready(box["out"][0].tick)
+                box["t_ready"] = time.perf_counter()
+
+        def probe():
+            return "t_ready" in box \
+                or bool(box["out"][0].tick.is_ready())
+
+        def resolve():
+            states, staged = box["out"]
+            pack = box["pack"]
+            execute = box["t_ready"] - box["t_launch"]
+            t_f0 = time.perf_counter()
+            a_h, r_h, s_h, r2_h = \
+                self._dense_trace_finish_host(staged, nr)
+            final_h = jax.device_get(states)
+            if int(final_h.tick) != total:
+                raise RuntimeError(
+                    "canonical fleet trace did not complete all ticks")
+            lanes = self._canon_trace_lanes(
+                cfgs, scheds, final_h, nr, [a_h], [r_h], [s_h], [r2_h])
+            fetch = time.perf_counter() - t_f0
+            wall = pack + execute + fetch
+            for lane in lanes:
+                lane.wall_seconds = wall
+            return FleetResult(lanes=lanes, wall_seconds=wall,
+                               padded_batch=b if nr < b else 0,
+                               device_seconds=execute,
+                               pack_seconds=pack, fetch_seconds=fetch)
+
+        pending = PendingFleet(resolve, stage_s,
+                               hold=(states0, sscheds, box),
+                               start_fn=start, wait_fn=wait,
+                               probe_fn=probe)
+        if not defer:
+            pending.start()
+        return pending
+
+    # modes the canonical path deliberately does not serve — the
+    # serving layer's canonical_supported gate routes them to exact
+    # buckets before a CanonicalFleetSimulation is ever constructed
+    def run_bench(self, *a, **kw):
+        raise NotImplementedError(
+            "canonical buckets serve dense trace only; bench mode "
+            "bakes the active-corner width and keeps exact buckets")
+
+    def launch_bench(self, *a, **kw):
+        raise NotImplementedError(
+            "canonical buckets serve dense trace only; bench mode "
+            "bakes the active-corner width and keeps exact buckets")
+
+    def run_leg(self, *a, **kw):
+        raise NotImplementedError(
+            "canonical buckets serve monolithic traces only; "
+            "checkpoint legs validate exact-plan cuts and keep "
+            "exact buckets")
+
+    def launch_leg(self, *a, **kw):
+        raise NotImplementedError(
+            "canonical buckets serve monolithic traces only; "
+            "checkpoint legs validate exact-plan cuts and keep "
+            "exact buckets")

@@ -74,7 +74,8 @@ class TickEvents:
 
 def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
               use_pallas: bool | None = None, with_events: bool = True,
-              n_active: int | None = None):
+              n_active: int | None = None,
+              lane_drop_window: bool = False):
     """Build the tick function for a config (shapes are static).
 
     Returned signature: ``tick(state, sched) -> (state', TickEvents)``.
@@ -94,6 +95,15 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
     width natively; passing the same ``n_active`` here makes the
     full-width path consume the byte-identical stream, which is what
     the corner differential tests rely on.  Default: N.
+
+    ``lane_drop_window`` re-applies each lane's EXACT drop window from
+    the ``Schedule.drop_open``/``drop_close`` scalars on top of the
+    windowed draw.  The canonical fleet path (service/canonical.py)
+    shares one QUANTIZED superset window as ``drop_active`` across
+    lanes whose exact windows differ — the draw itself depends only on
+    (rng, t, n_active), so masking it back to the exact window yields
+    the solo run's masks bit-for-bit while the shared cond predicate
+    stays unbatched (cond-stays-cond, analysis/jaxpr_audit.py).
     """
     comm = comm or LocalComm(use_pallas)
     n = cfg.n
@@ -210,6 +220,15 @@ def make_tick(cfg: SimConfig, block_size: int = 128, comm=None,
         gdrop_all, qdrop, pdrop = tick_drop_masks(
             state.rng, t, na, sched.drop_active[t], sched.drop_prob,
             link_prob=sched.link_prob[:na, :na] if asym else None)
+        if lane_drop_window:
+            # canonical fleets share a quantized superset window as
+            # drop_active; mask the draw back to this lane's exact
+            # window (scalar gate, so ticks outside it drop nothing —
+            # exactly the solo run's all-False cond branch)
+            lane_open = (t > sched.drop_open) & (t <= sched.drop_close)
+            gdrop_all = gdrop_all & lane_open
+            qdrop = qdrop & lane_open
+            pdrop = pdrop & lane_open
         if na < n:
             # embed the active-corner stream; pairs outside the corner
             # never carry a send, so their mask bits are dead

@@ -333,3 +333,47 @@ def plan_signature(cfg: SimConfig) -> tuple:
             # window of their own; flap/wave/partition knobs must not
             # collide across distinct configs whose unions coincide)
             cfg.worlds_key())
+
+
+def quantize_tick(t: int, grid: int = CHECKPOINT_GRID_TICKS,
+                  up: bool = False) -> int:
+    """Snap a phase-window edge to the checkpoint grid: lo edges round
+    DOWN (``up=False``), hi edges round UP — so a window built from
+    quantized edges is always a SUPERSET of the exact window, which is
+    what lets the canonical fleet path share one windowed cond across
+    lanes and mask back to each lane's exact window
+    (service/canonical.py).  Sentinels pass through unchanged (the
+    ``_INF`` "never" horizon and negative "no window" edges)."""
+    if t >= _INF or t < 0:
+        return t
+    return ((t + grid - 1) // grid) * grid if up else (t // grid) * grid
+
+
+def quantized_plan_signature(cfg: SimConfig,
+                             grid: int = CHECKPOINT_GRID_TICKS) -> tuple:
+    """:func:`plan_signature` over the GRID-QUANTIZED plan: every
+    phase-window edge snapped to the ``CHECKPOINT_GRID_TICKS`` grid
+    (lo down, hi up) and the worlds tail reduced to the operand-vs-
+    static split (worlds.canonical_world_key) — so near-identical
+    schedules fall into one equivalence class and share one compiled
+    fleet program, with the exact windows riding as Schedule data.
+    This is a CANONICAL-path key only (service/canonical.py): the
+    exact :func:`plan_signature` keeps guarding the solo run cache and
+    the checkpoint-leg cut validation, neither of which the canonical
+    path serves.  The ONLY window this key carries is the drop-draw
+    window, quantized as a dedicated ``(open, close)`` pair: the
+    class-shared ``drop_active`` cond plane is rebuilt from it alone.
+    Every other phase edge — start ramp, fail/rejoin windows, the
+    partition and flap windows — rides the batched Schedule as
+    per-lane operands on the monolithic canonical path (it elides no
+    phases and validates no cuts), so keying them would only split
+    classes that compile to the same program; the exact
+    :func:`plan_signature` still pins all of them wherever segment
+    identity is real.
+    """
+    from .. import worlds
+    drop_q = ((quantize_tick(cfg.drop_open_tick, grid),
+               quantize_tick(cfg.drop_close_tick, grid, up=True))
+              if cfg.drop_msg else None)
+    return ("segplan-q", grid, cfg.total_ticks, drop_q,
+            worlds.canonical_world_key(cfg, grid))

@@ -22,6 +22,17 @@ Partial batches are padded with FILLER lanes: replicas of the
 bucket's first-seen config (same shape by construction, seed
 irrelevant — filler results are masked out device-side and never
 unstacked, core/fleet.py ``n_real``).
+
+This EXACT key is one end of a dial.  Under a jittered mixed stream
+(the PR 15 scenario grammar) it degenerates toward one bucket — and
+one fresh XLA build — per request; ``FleetService(canonicalize=True)``
+buckets by the CANONICAL equivalence-class key instead
+(service/canonical.py: pad-ladder rungs over ``n``, quantized phase
+windows, world parameters as runtime operands), collapsing that
+stream to one program per class while staying bit-identical per lane.
+The exact key remains the fallback for everything canonicalization
+does not serve (overlay, bench, checkpoint legs) and the MEMBER
+identity recorded per class (ProgramCache.stats()["classes"]).
 """
 
 from __future__ import annotations

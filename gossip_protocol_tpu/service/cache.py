@@ -67,8 +67,25 @@ class ProgramCache:
         self.mesh_rebinds = 0
         self.rekey_hits = 0
         self._builds0 = run_build_count()
+        # canonical observability (PR 16): canonical bucket key ->
+        # {"hits": dispatches served, "members": exact bucket keys
+        # that would each have been their OWN bucket pre-canonical} —
+        # the collapse ratio len(members)/1 per class is the whole
+        # point of the pad-ladder, so it must be measurable here
+        self._classes: OrderedDict = OrderedDict()
 
-    def _make_sim(self, cfg: SimConfig) -> FleetSimulation:
+    def _make_sim(self, cfg: SimConfig,
+                  canonical: bool = False) -> FleetSimulation:
+        if canonical:
+            if self._mesh is not None:
+                raise ValueError(
+                    "canonical buckets are single-device only (the "
+                    "mesh path shards the real peer axis; pad-ladder "
+                    "filler peers would change its decomposition)")
+            from ..core.fleet import CanonicalFleetSimulation
+            return CanonicalFleetSimulation(
+                cfg, block_size=self._block_size,
+                chunk_ticks=self._chunk_ticks)
         if self._mesh is not None:
             from ..parallel.fleet_mesh import MeshFleetSimulation
             return MeshFleetSimulation(cfg, self._mesh,
@@ -84,7 +101,8 @@ class ProgramCache:
         from ..parallel.fleet_mesh import mesh_descriptor
         return mesh_descriptor(self._mesh)
 
-    def get(self, key: tuple, cfg: SimConfig) -> FleetSimulation:
+    def get(self, key: tuple, cfg: SimConfig,
+            members=None) -> FleetSimulation:
         """The bucket's fleet handle (created on first use).
 
         ``cfg`` seeds the handle's shape on a miss; later calls with
@@ -99,12 +117,27 @@ class ProgramCache:
         Cross-mesh staleness is impossible either way because the
         handles' compiled programs carry the mesh slot in their own
         process-cache keys (core/fleet.py ``_mesh_entry``).
+
+        A ``"canon"``-leading ``key`` (service/canonical.py) creates a
+        :class:`~..core.fleet.CanonicalFleetSimulation` handle serving
+        the whole equivalence class; ``members`` is then the batch's
+        EXACT bucket keys (one per lane config), recorded per class so
+        :meth:`stats` can report the measured collapse — how many
+        would-have-been-their-own buckets each canonical program
+        absorbed.
         """
+        canonical = bool(key) and key[0] == "canon"
+        if canonical:
+            cls = self._classes.setdefault(
+                key, {"hits": 0, "members": set()})
+            cls["hits"] += 1
+            if members is not None:
+                cls["members"].update(members)
         full = (self._desc(), key)
         sim = self._sims.get(full)
         if sim is None:
             self.misses += 1
-            sim = self._make_sim(cfg)
+            sim = self._make_sim(cfg, canonical=canonical)
             self._sims[full] = sim
             if self.max_entries is not None \
                     and len(self._sims) > self.max_entries:
@@ -168,7 +201,18 @@ class ProgramCache:
         total = self.hits + self.misses
         return self.hits / total if total else 0.0
 
+    def class_map(self) -> dict:
+        """canonical bucket key -> {"hits", "members"} (members is the
+        SET of exact bucket keys served from the class — each one a
+        fresh XLA build pre-canonicalization, one build now)."""
+        return {k: {"hits": v["hits"],
+                    "members": frozenset(v["members"])}
+                for k, v in self._classes.items()}
+
     def stats(self) -> dict:
+        classes = {
+            repr(k): {"hits": v["hits"], "members": len(v["members"])}
+            for k, v in self._classes.items()}
         return {"buckets": len(self._sims), "hits": self.hits,
                 "misses": self.misses,
                 "hit_rate": round(self.hit_rate, 4),
@@ -177,5 +221,8 @@ class ProgramCache:
                 "mesh_rebinds": self.mesh_rebinds,
                 "rekey_hits": self.rekey_hits,
                 "max_entries": self.max_entries,
+                "classes": classes,
+                "class_member_buckets": sum(
+                    len(v["members"]) for v in self._classes.values()),
                 "devices": (self._mesh.devices.size
                             if self._mesh is not None else 1)}

@@ -1,0 +1,138 @@
+"""Bucket canonicalization: bound the compile surface of a mixed mix.
+
+PR 15's scenario grammar (25 families x 8 worlds, jittered per
+request) drives the exact bucket key (service/bucket.py) toward
+every-request-its-own-bucket — each paying a fresh XLA build, the
+failure mode continuous-batching servers solve with shape bucketing
+(Orca, OSDI'22).  This module collapses near-identical dense trace
+requests into CANONICAL equivalence classes along three layers:
+
+* **n pad-ladder** — a dense config's peer count is padded up to the
+  next power-of-two rung with INERT filler peers
+  (state.pad_schedule_host: never introduced, never known, state rows
+  identically zero) and results are sliced back to the real ``n``
+  host-side, so ``fleet_shape_key``'s ``n`` component quantizes to
+  ladder rungs.
+* **plan-signature equivalence classes** — phase windows quantize to
+  the ``CHECKPOINT_GRID_TICKS`` grid
+  (models/segments.quantized_plan_signature); the exact windows ride
+  as Schedule data (``drop_open``/``drop_close`` scalars, the
+  injection arrays).
+* **runtime world operands** — world parameters the compiled tick
+  never bakes (drop probability, byz_boost, wave radius/rate, flap
+  knobs, the partition/flap windows, link matrices —
+  worlds.OPERAND_WORLD_FIELDS) are dropped from the key entirely;
+  only the active-plane SET stays static
+  (worlds.canonical_world_key), matching exactly the booleans
+  ``core/tick.make_tick`` branches on.  The drop-draw window is the
+  ONE window that stays (quantized) key material: it rebuilds the
+  class-shared ``drop_active`` cond plane.
+
+Honesty gates: a canonical run must be BIT-IDENTICAL to its exact
+solo run (tests/test_canonical.py pins this per tick), and the shared
+quantized drop window must keep the draw cond a real cond under vmap
+(cond-stays-cond, analysis/jaxpr_audit.py "fleet-dense-canonical").
+
+Scope: canonicalization serves MONOLITHIC dense trace dispatches.
+Overlay configs compile ~the whole config statically (their
+fleet_shape_key is the config), dense bench mode bakes the
+active-corner width, and checkpoint legs validate resume cuts against
+the exact plan — all three keep the exact bucket key, and
+:func:`canonical_bucket_key` falls back to it.
+"""
+
+from __future__ import annotations
+
+from ..config import SimConfig
+from ..models.segments import CHECKPOINT_GRID_TICKS, quantize_tick, \
+    quantized_plan_signature
+from .types import MODES
+
+#: smallest pad-ladder rung: below this every n shares one program
+#: anyway and padding overhead is noise
+LADDER_MIN = 4
+
+
+def ladder_rung(n: int) -> int:
+    """Next power-of-two rung >= max(n, LADDER_MIN)."""
+    r = LADDER_MIN
+    while r < n:
+        r *= 2
+    return r
+
+
+def canonical_supported(cfg: SimConfig, mode: str) -> bool:
+    """May this request be served from a canonical bucket?  Dense
+    trace only (see module docstring for why overlay and bench keep
+    exact keys)."""
+    return cfg.model != "overlay" and mode == "trace"
+
+
+def canonical_fleet_shape_key(cfg: SimConfig) -> tuple:
+    """The pad-ladder twin of ``core/fleet.fleet_shape_key`` for dense
+    configs: ``n`` quantizes to its ladder rung, and the worlds tail
+    reduces to the static plane booleans the tick actually bakes.
+
+    ``stream_n`` pins the REAL peer count for drop/asym configs: the
+    Bernoulli drop lattice is drawn at the real width and embedded
+    into the rung (make_tick ``n_active``), so lanes of different real
+    n cannot share a drop-on program without changing each other's
+    draw stream — no cross-n collapse there, by bit-identity.  Drop-off
+    configs never take the draw branch, so their rung programs are
+    width-only and collapse across n freely.
+    """
+    rung = ladder_rung(cfg.n)
+    stream_n = cfg.n if (cfg.drop_msg or cfg.asym_drop) else None
+    return ("canon_full_view", rung, stream_n, cfg.t_remove,
+            cfg.total_ticks,
+            # exactly the static branch booleans of make_tick
+            cfg.rejoin_after is not None or cfg.flap_rate > 0,  # churn
+            cfg.partition_groups >= 2,                          # partition
+            cfg.asym_drop,                                      # asym
+            cfg.zombie,                                         # zombie
+            cfg.byz_rate > 0,                                   # byz
+            cfg.link_latency > 0)                               # latency
+
+
+def canonical_bucket_key(cfg: SimConfig, mode: str) -> tuple:
+    """Equivalence-class key: requests with equal keys ride ONE
+    compiled canonical program.  Falls back to the exact
+    ``bucket_key`` when canonicalization does not apply — the caller
+    can always tell which it got (canonical keys lead with
+    ``"canon"``)."""
+    if mode not in MODES:
+        raise ValueError(f"unknown mode {mode!r}; expected one of {MODES}")
+    if not canonical_supported(cfg, mode):
+        from .bucket import bucket_key
+        return bucket_key(cfg, mode)
+    return ("canon", mode, canonical_fleet_shape_key(cfg),
+            quantized_plan_signature(cfg))
+
+
+def canonical_drop_window(cfg: SimConfig,
+                          grid: int = CHECKPOINT_GRID_TICKS):
+    """The bucket-shared quantized drop window ``(open, close)`` —
+    a SUPERSET of every member's exact window (lo rounds down, hi
+    rounds up), pure function of key material so all lanes of a class
+    agree on it by construction.  None when the drop plane is off."""
+    if not cfg.drop_msg:
+        return None
+    return (quantize_tick(cfg.drop_open_tick, grid),
+            quantize_tick(cfg.drop_close_tick, grid, up=True))
+
+
+def canonical_drop_active(cfg: SimConfig,
+                          grid: int = CHECKPOINT_GRID_TICKS):
+    """bool[T] shared drop plane of a canonical bucket: the quantized
+    superset window.  Ticks inside the superset but outside a lane's
+    exact window DO take the draw branch — and the draw depends only
+    on (rng, tick, stream width), so masking its output with the exact
+    per-lane window (make_tick ``lane_drop_window``) reproduces the
+    solo run's masks bit-for-bit."""
+    import numpy as np
+    t = np.arange(cfg.total_ticks, dtype=np.int32)
+    win = canonical_drop_window(cfg, grid)
+    if win is None:
+        return np.zeros(cfg.total_ticks, bool)
+    lo, hi = win
+    return (t > lo) & (t <= hi)

@@ -426,3 +426,211 @@ def load_openloop_bench(smoke: bool = False, seed: int = 20260804,
         entry["mesh_point"] = {"devices": 2, "max_batch_per_device": 4,
                                **mesh_row}
     return entry
+
+
+# ---- compile-surface bench (PR 16 tentpole) --------------------------
+#
+# The scenario grammar (models/scenarios.py, 25 families over eight
+# worlds) jittered per request drives the EXACT bucket key toward one
+# fresh XLA build per request; canonical bucketing
+# (service/canonical.py) must collapse that — measured, not assumed.
+# The bench drives the SAME mixed schedule through a baseline
+# (canonicalize=False) service lap, a cold canonical lap, and a warm
+# canonical lap, and gates on: per-request BIT-IDENTITY between the
+# laps (the exact lap is the solo-equivalent reference; a sample is
+# additionally checked against direct solo execution), ZERO builds on
+# the warm lap, and (full runs) a >= 3x fresh-build collapse.
+
+#: dense phase-window jitter stays within one CHECKPOINT_GRID_TICKS
+#: cell on most draws (so quantization gets to collapse it) but
+#: occasionally crosses a grid line (so class splits are exercised too)
+_JITTER_TICKS = 5
+
+
+def jitter_request(cfg, rng):
+    """One grammar request, jittered the way a real mixed stream is:
+    peer count off the power-of-two rungs, phase windows off the grid,
+    world parameters (drop probability, byz boost, latency, wave
+    shape) perturbed per request.  Overlay configs pass through —
+    their bucket is exact by design and seed jitter alone keeps it
+    warm.  Every jitter axis is one the canonical key either absorbs
+    (operands, ladder, quantization) or legitimately splits on
+    (grid-line crossings, drop-on real n)."""
+    if cfg.model == "overlay":
+        return cfg
+    from ..service.canonical import ladder_rung
+    rung = ladder_rung(cfg.n)
+    kw = {"max_nnb": int(rng.integers(rung // 2 + 2, cfg.n + 1))}
+    j = lambda: int(rng.integers(0, _JITTER_TICKS))
+
+    def win(lo, hi):
+        lo2 = lo + j()
+        return lo2, max(lo2 + 2, hi - j())
+    if cfg.drop_msg:
+        kw["msg_drop_prob"] = round(
+            float(cfg.msg_drop_prob * rng.uniform(0.6, 1.4)), 4)
+        kw["drop_open_tick"], kw["drop_close_tick"] = \
+            win(cfg.drop_open_tick, cfg.drop_close_tick)
+    if cfg.partition_groups >= 2:
+        kw["partition_open_tick"], kw["partition_close_tick"] = \
+            win(cfg.partition_open_tick, cfg.partition_close_tick)
+    if cfg.flap_rate > 0 and cfg.flap_open_tick >= 0:
+        # -1/-1 means the default (total-derived) flap window; leave it
+        kw["flap_open_tick"], kw["flap_close_tick"] = \
+            win(cfg.flap_open_tick, cfg.flap_close_tick)
+    if not cfg.single_failure:
+        kw["wave_tick"] = cfg.wave_tick + j()
+        kw["wave_size"] = max(2, cfg.wave_size - int(rng.integers(0, 2)))
+    elif cfg.fail_tick < cfg.total_ticks:
+        kw["fail_tick"] = cfg.fail_tick + j()
+    if cfg.byz_rate > 0:
+        kw["byz_boost"] = max(2, cfg.byz_boost + int(rng.integers(-2, 3)))
+    if cfg.link_latency > 0:
+        kw["link_latency"] = max(1, cfg.link_latency
+                                 + int(rng.integers(-1, 2)))
+    return cfg.replace(**kw)
+
+
+def compile_surface_schedule(n_requests: int, seed: int,
+                             families=None) -> list:
+    """The mixed composed-world schedule: ``n_requests`` configs drawn
+    family-round-robin from the scenario grammar, each jittered by
+    :func:`jitter_request` under one seeded rng — deterministic, so
+    baseline and canonical laps serve the byte-identical stream."""
+    from ..models.scenarios import CATALOG
+    fams = [CATALOG[f] for f in (families or sorted(CATALOG))]
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n_requests):
+        fam = fams[i % len(fams)]
+        out.append(jitter_request(fam.build(seed + i), rng))
+    return out
+
+
+def _surface_lap(svc: "FleetService", cfgs) -> tuple:
+    """Submit the whole schedule, drain, return (digests, builds)."""
+    from ..core.tick import run_build_count
+    from ..models.scenarios import _lane_digest
+    b0 = run_build_count()
+    handles = [svc.submit(c, mode="trace") for c in cfgs]
+    svc.drain()
+    digests = [_lane_digest(c, h.result())
+               for c, h in zip(cfgs, handles)]
+    return digests, run_build_count() - b0
+
+
+def compile_surface_bench(smoke: bool = False, seed: int = 20260807,
+                          n_requests: Optional[int] = None,
+                          max_batch: int = 4,
+                          solo_every: int = 10,
+                          now=time.perf_counter) -> dict:
+    """Measure the compile-surface collapse on a jittered mixed
+    schedule; the ``secondary.compile_surface`` BENCH entry.
+
+    Three laps over the byte-identical schedule: baseline exact
+    buckets (the pre-canonicalization compile surface), cold canonical
+    buckets, warm canonical buckets (same service, same schedule
+    again).  Gates enforced in-line, not just recorded:
+
+    * every request's canonical result digest equals its baseline
+      (exact-bucket) digest, and a deterministic sample is ALSO
+      checked against direct solo execution — bit-identity is the
+      honesty condition of the whole scheme;
+    * the warm lap observes ZERO fresh builds (the steady-state
+      serving claim);
+    * full runs only: fresh builds collapse by >= 3x cold (smoke
+      schedules are too small to gate a ratio on).
+    """
+    from ..core.tick import run_build_count
+    from ..models.scenarios import CATALOG, _lane_digest
+    if smoke:
+        # the eight cheapest dense families still span drop / window /
+        # operand jitter; 48 requests keep the baseline lap's build
+        # bill (~one per request, the point) under a smoke budget
+        families = ["dense_partition_blip", "dense_asym_drop",
+                    "dense_wave", "dense_zombie", "dense_flapping",
+                    "dense_latency", "dense_composed_part_flap",
+                    "dense_composed_latency_flap"]
+        n = 48 if n_requests is None else n_requests
+    else:
+        families = sorted(CATALOG)
+        n = 200 if n_requests is None else n_requests
+    cfgs = compile_surface_schedule(n, seed, families)
+    t0 = now()
+
+    from .bucket import bucket_key
+    from .canonical import canonical_bucket_key
+    exact_keys = {bucket_key(c, "trace") for c in cfgs}
+    canon_keys = {canonical_bucket_key(c, "trace") for c in cfgs}
+
+    base_svc = FleetService(max_batch=max_batch)
+    base_digests, base_builds = _surface_lap(base_svc, cfgs)
+    t_base = now()
+
+    canon_svc = FleetService(max_batch=max_batch, canonicalize=True)
+    canon_digests, canon_builds = _surface_lap(canon_svc, cfgs)
+    t_cold = now()
+    stats_cold = canon_svc.stats()["cache"]
+    hits0 = stats_cold["hits"] + stats_cold["misses"]
+
+    warm_digests, warm_builds = _surface_lap(canon_svc, cfgs)
+    stats_warm = canon_svc.stats()["cache"]
+    lap2 = (stats_warm["hits"] + stats_warm["misses"]) - hits0
+    warm_hit_rate = round(
+        (stats_warm["hits"] - stats_cold["hits"]) / lap2, 4) \
+        if lap2 else 0.0
+
+    # ---- gates ----
+    bad = [i for i, (a, b) in enumerate(zip(base_digests, canon_digests))
+           if a != b]
+    bad += [i for i, (a, b) in enumerate(zip(base_digests, warm_digests))
+            if a != b]
+    if bad:
+        raise RuntimeError(
+            f"canonical serving diverged from exact on request(s) "
+            f"{sorted(set(bad))[:8]} of {n} — bit-identity is the "
+            "precondition of bucket canonicalization")
+    from .resilience import solo_execute
+    solo_checked = 0
+    for i in range(0, n, max(1, solo_every)):
+        d = _lane_digest(cfgs[i], solo_execute(cfgs[i], "trace"))
+        if d != canon_digests[i]:
+            raise RuntimeError(
+                f"canonical result for request {i} diverged from its "
+                f"direct solo run ({d} != {canon_digests[i]})")
+        solo_checked += 1
+    if warm_builds != 0:
+        raise RuntimeError(
+            f"warm canonical lap observed {warm_builds} fresh builds; "
+            "steady-state serving must not recompile")
+    collapse = round(base_builds / canon_builds, 2) \
+        if canon_builds else float(base_builds)
+    if not smoke and collapse < 3.0:
+        raise RuntimeError(
+            f"compile-surface collapse {collapse}x is below the 3x "
+            f"gate (baseline {base_builds} builds, canonical "
+            f"{canon_builds}) — canonicalization regressed")
+
+    classes = canon_svc.cache.class_map()
+    return {
+        "requests": n,
+        "families": len(families),
+        "smoke": smoke,
+        "buckets_exact": len(exact_keys),
+        "buckets_canonical": len(canon_keys),
+        "bucket_collapse_x": round(len(exact_keys)
+                                   / max(len(canon_keys), 1), 2),
+        "builds_baseline": int(base_builds),
+        "builds_canonical": int(canon_builds),
+        "build_collapse_x": collapse,
+        "warm_builds": int(warm_builds),
+        "warm_hit_rate": warm_hit_rate,
+        "classes": len(classes),
+        "max_class_members": max(
+            (len(v["members"]) for v in classes.values()), default=0),
+        "parity_ok": True,
+        "solo_checked": solo_checked,
+        "baseline_wall_s": round(t_base - t0, 1),
+        "canonical_wall_s": round(t_cold - t_base, 1),
+        "bench_wall_s": round(now() - t0, 1),
+    }

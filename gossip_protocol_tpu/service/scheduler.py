@@ -172,6 +172,7 @@ class FleetService:
                  pump_harvest: Optional[bool] = None,
                  checkpoint_every: Optional[int] = None,
                  checkpoint_every_s: Optional[float] = None,
+                 canonicalize: bool = False,
                  store=None, run_dir: Optional[str] = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -194,6 +195,17 @@ class FleetService:
             raise ValueError("checkpoint_every (ticks) and "
                              "checkpoint_every_s (seconds) are two "
                              "spellings of one budget; set at most one")
+        if canonicalize and (checkpoint_every is not None
+                             or checkpoint_every_s is not None):
+            raise ValueError(
+                "canonicalize is incompatible with checkpointed "
+                "serving: legs validate resume cuts against the EXACT "
+                "segment plan, which canonical buckets quantize away")
+        if canonicalize and mesh is not None:
+            raise ValueError(
+                "canonicalize is single-device only: the mesh path "
+                "shards the real peer axis, which the pad-ladder "
+                "would re-shape per rung")
         self.max_batch = max_batch
         self.max_wait_s = max_wait_s
         self.pad_policy = pad_policy
@@ -226,6 +238,15 @@ class FleetService:
         #: bucket with no wall measurement yet dispatches monolithic
         #: (warm() seeds the estimate, so warmed buckets never do).
         self.checkpoint_every_s = checkpoint_every_s
+        #: canonical bucketing (the PR 16 tentpole,
+        #: service/canonical.py): requests queue and batch under
+        #: EQUIVALENCE-CLASS keys — peer counts quantized to pad-ladder
+        #: rungs, phase windows to the checkpoint grid, world
+        #: parameters demoted to runtime operands — so a jittered
+        #: mixed stream compiles one program per CLASS instead of one
+        #: per distinct config.  Modes canonicalization does not serve
+        #: (overlay, bench) fall back to exact buckets per request.
+        self.canonicalize = canonicalize
         self.clock = clock
         self.cache = ProgramCache(block_size=block_size,
                                   chunk_ticks=chunk_ticks, mesh=mesh,
@@ -416,7 +437,7 @@ class FleetService:
                 raise TenantQuotaExceeded(tenant, held, self.tenant_quota)
         if seed is not None:
             cfg = cfg.replace(seed=int(seed))
-        key = bucket_key(cfg, mode)
+        key = self._bucket(cfg, mode)
         now = self.clock()
         budget = deadline_s
         if budget is None:
@@ -466,7 +487,7 @@ class FleetService:
         directly under the matching resume sub-bucket, exactly where
         the dead process left it.
         """
-        key = bucket_key(cfg, mode)
+        key = self._bucket(cfg, mode)
         req = SimRequest(rid=rid, cfg=cfg, mode=mode, bucket=key,
                          submit_s=self.clock(), priority=priority,
                          tenant=tenant)
@@ -704,6 +725,17 @@ class FleetService:
         return False
 
     # ---- dispatch ----------------------------------------------------
+    def _bucket(self, cfg: SimConfig, mode: str) -> tuple:
+        """The queue/bucket key for one request: the equivalence-class
+        key when ``canonicalize`` is on (service/canonical.py;
+        requests it cannot serve fall back to exact keys inside
+        ``canonical_bucket_key``), the exact ``bucket_key``
+        otherwise."""
+        if self.canonicalize:
+            from .canonical import canonical_bucket_key
+            return canonical_bucket_key(cfg, mode)
+        return bucket_key(cfg, mode)
+
     @staticmethod
     def _base_key(key: tuple) -> tuple:
         """A queue key without its resume marker (PR 8): checkpointed
@@ -1116,7 +1148,10 @@ class FleetService:
         base = self._base_key(key)
         cfgs = [r.cfg for r in reqs]
         width = self._width(len(cfgs))
-        sim = self.cache.get(base, cfgs[0])
+        sim = self.cache.get(
+            base, cfgs[0],
+            members=([bucket_key(r.cfg, r.mode) for r in reqs]
+                     if base and base[0] == "canon" else None))
         if fault == "dispatch":
             raise InjectedDispatchFailure(idx)
         leg = self._leg_ticks(reqs)
@@ -1521,8 +1556,11 @@ class FleetService:
         ones (programs included), so size the bound to the working set
         before a warm sweep.
         """
-        key = bucket_key(cfg, mode)
-        sim = self.cache.get(key, cfg)
+        key = self._bucket(cfg, mode)
+        sim = self.cache.get(
+            key, cfg,
+            members=([bucket_key(cfg, mode)]
+                     if key and key[0] == "canon" else None))
         self._filler.setdefault(key, cfg)
         self._bucket_stats.setdefault(key, {"requests": 0, "dispatches": 0,
                                             "builds": 0})
@@ -1687,6 +1725,10 @@ class FleetService:
             "elastic": dict(self._elastic),
             "checkpoint_every": self.checkpoint_every,
             "checkpoint_every_s": self.checkpoint_every_s,
+            # the compile-surface plane (PR 16): whether requests
+            # bucket by canonical equivalence class; the per-class
+            # collapse map rides in "cache"["classes"]
+            "canonicalize": self.canonicalize,
             # the durability plane (PR 12, gossip_protocol_tpu/store/):
             # spill/journal/recovery counters when a RunStore rides;
             # None on a store-less service (the key is always present

@@ -93,6 +93,14 @@ class Schedule:
                             #          sentinel = stays dead
     drop_active: jax.Array  # bool[T] — dropmsg flag value during tick t's sends
     drop_prob: jax.Array    # f32 scalar — MSG_DROP_PROB
+    drop_open: jax.Array    # i32 scalar — EXACT drop window of this lane:
+    drop_close: jax.Array   #   open < t <= close ((-1, -2) = no window).
+                            #   Redundant with drop_active for solo runs;
+                            #   the canonical fleet path (service/canonical
+                            #   .py) shares a QUANTIZED superset window as
+                            #   drop_active across lanes and re-applies the
+                            #   exact window from these scalars, per lane,
+                            #   after the draw (make_tick lane_drop_window)
     # --- adversarial failure worlds (worlds.py); every field below is
     # --- inert data (zeros / empty) when its world is off ---
     part_group: jax.Array   # i32[N] — hashed partition group per node
@@ -232,6 +240,8 @@ def make_schedule_host(cfg: SimConfig) -> Schedule:
         rejoin_tick=rejoin,
         drop_active=drop,
         drop_prob=np.float32(cfg.msg_drop_prob),
+        drop_open=np.int32(cfg.drop_open_tick if cfg.drop_msg else -1),
+        drop_close=np.int32(cfg.drop_close_tick if cfg.drop_msg else -2),
         part_group=worlds.partition_groups_host(cfg),
         part_on=np.bool_(cfg.partition_groups >= 2),
         part_open=np.int32(part_open),
@@ -275,6 +285,50 @@ def slice_schedule(s: Schedule, a: int) -> Schedule:
         byz_mask=s.byz_mask[:a], byz_target=s.byz_target[:a, :a],
         link_lat=s.link_lat[:a, :a])
 
+
+def pad_schedule_host(s: Schedule, width: int) -> Schedule:
+    """Embed a width-``n`` schedule into a width-``width`` one with
+    INERT filler rows — the peer-axis generalization of the fleet's
+    filler lanes (service/canonical.py pad-ladder).  Filler peers get
+    ``start_tick = NEVER``: they are never introduced, never send a
+    JOINREQ, are never known by anyone, and their state rows stay
+    identically zero for the whole run, so the real ``n x n`` corner
+    of a padded run is bit-identical to the unpadded run
+    (tests/test_canonical.py pins this per tick).  Matrix world
+    planes pad with values that are dead by construction (no send
+    ever leaves the real corner): ``link_prob`` 0, ``byz_target``
+    False, ``link_lat`` 1.  Window scalars and ``drop_active`` are
+    width-independent and pass through.  Host numpy only."""
+    n = int(s.start_tick.shape[0])
+    if width == n:
+        return s
+    if width < n:
+        raise ValueError(f"pad width {width} < schedule width {n}")
+
+    def vec(a, fill):
+        out = np.full((width,), fill, np.asarray(a).dtype)
+        out[:n] = a
+        return out
+
+    def plane(a, fill):
+        a = np.asarray(a)
+        if a.size == 0:          # (0, 0) placeholder: plane is off
+            return a
+        out = np.full((width, width), fill, a.dtype)
+        out[:n, :n] = a
+        return out
+
+    return s.replace(
+        start_tick=vec(s.start_tick, NEVER),
+        fail_tick=vec(s.fail_tick, NEVER),
+        rejoin_tick=vec(s.rejoin_tick, NEVER),
+        part_group=vec(s.part_group, 0),
+        link_prob=plane(s.link_prob, 0.0),
+        flap_mask=vec(s.flap_mask, False),
+        flap_phase=vec(s.flap_phase, 0),
+        byz_mask=vec(s.byz_mask, False),
+        byz_target=plane(s.byz_target, False),
+        link_lat=plane(s.link_lat, 1))
 
 
 def init_state(cfg: SimConfig) -> WorldState:

@@ -356,3 +356,62 @@ def composition(cfg: SimConfig) -> tuple[str, tuple[str, ...]]:
     if cfg.link_latency > 0:
         active.append("latency")
     return script, tuple(active)
+
+
+#: world parameters that ride as RUNTIME OPERANDS on the canonical
+#: fleet path (service/canonical.py): each maps a plane tag to the
+#: SimConfig fields whose values flow through Schedule arrays/scalars
+#: instead of being baked into the compiled program.  The plane TAG
+#: itself stays static (the tick branches on plane on/off booleans,
+#: core/tick.make_tick), so "one program per family" means one program
+#: per active-plane SET — probabilities, boosts, radii, per-link
+#: matrices all become data.  analysis/cache_keys.py audits that every
+#: field named here is read by a DATA_FUNCS builder.
+OPERAND_WORLD_FIELDS = {
+    "drop": ("msg_drop_prob", "drop_open_tick", "drop_close_tick"),
+    "part": ("partition_groups", "partition_open_tick",
+             "partition_close_tick"),
+    "asym": (),                   # per-link matrix is Schedule data
+    "wave": ("wave_size", "wave_tick", "wave_speed"),
+    "flap": ("flap_rate", "flap_period", "flap_down",
+             "flap_open_tick", "flap_close_tick"),
+    "byz": ("byz_rate", "byz_boost"),
+    "lat": ("link_latency",),
+}
+
+
+def canonical_world_key(cfg: SimConfig, grid: int) -> tuple:
+    """The STATIC half of the operand-vs-static world split: the
+    active plane tags — exactly the booleans ``core/tick.make_tick``
+    bakes — and nothing else.  Every parameter in
+    :data:`OPERAND_WORLD_FIELDS` is omitted: it reaches the compiled
+    program as a traced operand via the Schedule (``drop_prob``,
+    ``byz_boost``, the flap scalars, the ``fail_tick`` wave script,
+    the link matrices), so two configs that differ only in those
+    values share one canonical program.  The partition and flap
+    WINDOWS are operands too (``part_open``/``part_close`` scalars
+    and the flap cycle anchors ride per-lane in SCHED_AXES_CANON —
+    both planes are deterministic masks computed OUTSIDE the drop
+    cond, state.py ``part_active_at``/``_flap_state``), so no window
+    appears here at all; the one window that must be class-shared is
+    the drop-draw cond's, carried as the quantized ``drop_q`` pair by
+    ``quantized_plan_signature`` itself.  ``grid`` is kept in the
+    signature so a future plane that does bake a window has its
+    quantization step on hand."""
+    del grid  # no window rides this key anymore; see docstring
+    ws = []
+    if cfg.partition_groups >= 2:
+        ws.append(("part",))
+    if cfg.asym_drop:
+        ws.append(("asym",))
+    if cfg.wave_size > 0:
+        ws.append(("wave",))
+    if cfg.zombie:
+        ws.append(("zombie",))
+    if cfg.flap_rate > 0:
+        ws.append(("flap",))
+    if cfg.byz_rate > 0:
+        ws.append(("byz",))
+    if cfg.link_latency > 0:
+        ws.append(("lat",))
+    return tuple(ws)

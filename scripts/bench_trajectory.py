@@ -10,7 +10,9 @@ saturation point, PR 7+), the scenario-frontier columns (variants
 graded + oracle pass rate, PR 9+), the durable-serving columns
 (kill/restart completion + spill volume, PR 12+), and the
 static-analysis columns (findings + rule-inventory size recorded by
-``bench --check``, PR 14+; older jsons without an entry render "-")
+``bench --check``, PR 14+; older jsons without an entry render "-"),
+and the compile-surface columns (exact vs canonical bucket
+cardinality, fresh-build collapse, warm-lap hit rate, PR 16+)
 — so a regression (or a claimed win) is visible at a glance, PR
 over PR.
 
@@ -109,6 +111,10 @@ def load_rows():
         # jaxpr/sharding/ast passes in-process and records the
         # verdict; older jsons without it render "-"
         lint = d.get("analysis") or {}
+        # compile-surface entry (PR 16+): the mixed-schedule bucket
+        # canonicalization gate — exact vs canonical bucket
+        # cardinality, fresh-build collapse, warm-lap hit rate
+        surf = sec.get("compile_surface") or {}
         rows.append({
             "pr": pr,
             "backend": d.get("backend"),
@@ -145,6 +151,12 @@ def load_rows():
                 else None),
             "lint_findings": lint.get("findings"),
             "lint_rules": lint.get("rules"),
+            "surface_buckets_exact": surf.get("buckets_exact"),
+            "surface_buckets_canonical": surf.get("buckets_canonical"),
+            "surface_builds_baseline": surf.get("builds_baseline"),
+            "surface_builds_canonical": surf.get("builds_canonical"),
+            "surface_build_collapse_x": surf.get("build_collapse_x"),
+            "surface_warm_hit_rate": surf.get("warm_hit_rate"),
         })
     return rows
 
@@ -183,7 +195,11 @@ def main(argv) -> int:
             ("recov", "recovery_completion", "{:.0%}"),
             ("spill MB", "recovery_spill_mb", "{:.1f}"),
             ("lint", "lint_findings", "{}"),
-            ("rules", "lint_rules", "{}")]
+            ("rules", "lint_rules", "{}"),
+            ("bkt", "surface_buckets_exact", "{}"),
+            ("canon", "surface_buckets_canonical", "{}"),
+            ("bld x", "surface_build_collapse_x", "{:.1f}"),
+            ("warm", "surface_warm_hit_rate", "{:.0%}")]
     table = [[_fmt(r.get(key), spec) for _, key, spec in cols]
              for r in rows]
     widths = [max(len(h), *(len(t[i]) for t in table))

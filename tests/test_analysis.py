@@ -17,6 +17,9 @@ here exactly as they do under ``python -m gossip_protocol_tpu
 .analysis`` (which re-execs itself to force the same flags).
 """
 
+import json
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -671,6 +674,58 @@ def test_round2_world_fields_are_covered_by_name():
         "byz_boost grew a direct builder read: add it to the diff pin"
 
 
+def test_canonical_key_fields_are_covered_by_name():
+    """PR 16 satellite: the canonical-key completeness diff covers the
+    three new key ingredients by name — the pad-ladder rung over ``n``
+    (max_nnb), the quantized phase windows, and the operand-vs-static
+    world split.  The split's pin is structural: fields that moved to
+    runtime operands (msg_drop_prob, byz_boost) must have NO direct
+    canonical-builder read at all — a read appearing there means a
+    world knob got re-baked into the shared program and the
+    equivalence class just went stale-capable."""
+    builders = cache_keys.canonical_builder_fields()
+    covered = cache_keys.canonical_covered_fields()
+    # ladder rung + quantized windows are key-folded
+    for fld in ("max_nnb", "drop_open_tick", "partition_open_tick",
+                "total_ticks"):
+        assert fld in covered, f"{fld} fell out of the canonical key"
+    # static shape discriminators still read by the shared builders
+    for fld in ("max_nnb", "t_remove", "partition_groups"):
+        assert fld in builders, f"builder scan lost {fld}"
+        missing = cache_keys.canonical_missing_fields(
+            builders=builders, covered=covered - {fld})
+        assert fld in missing, f"canonical diff went blind to {fld}"
+        assert missing[fld], f"no builder locations for {fld}"
+    # operand side of the split: these ride as traced operands /
+    # schedule data, never as canonical-builder bakes
+    for fld in ("msg_drop_prob", "byz_boost"):
+        assert fld not in builders, (
+            f"{fld} grew a direct canonical-builder read — a runtime "
+            "world operand got re-baked into the shared program")
+
+
+def test_unkeyed_canonical_field_fails_naming_builder_line():
+    """Satellite pin: a canonical-path builder read with no canonical
+    key coverage FAILS, and the finding names the builder line."""
+    fixture = cache_keys.fields_read_source("""
+def make_tick(cfg):
+    return cfg.wave_size + cfg.flap_rate
+""", funcs=("make_tick",), relfile="fixture_tick.py")
+    missing = cache_keys.canonical_missing_fields(
+        builders=fixture,
+        covered=cache_keys.canonical_covered_fields() - {"wave_size"})
+    assert set(missing) == {"wave_size"}
+    assert missing["wave_size"] == ["fixture_tick.py:3"]
+
+
+def test_clean_tree_passes_canonical_key_rule():
+    """The real tree has no canonical coverage gap, and check() would
+    report any under the ``canon-key-complete`` rule name."""
+    assert cache_keys.canonical_missing_fields() == {}
+    assert [f for f in cache_keys.check()
+            if f.rule == "canon-key-complete"] == []
+
+
 # ---- runtime guards --------------------------------------------------
 def test_compile_counter_counts_and_budget_trips():
     f = jax.jit(lambda x: x * 5 + 2)
@@ -776,3 +831,39 @@ def test_reexec_failure_exits_nonzero(monkeypatch):
     with pytest.raises(SystemExit) as e:
         cli._force_virtual_devices()
     assert e.value.code == 2
+
+
+# ---- bench --check trajectory row (PR 16 satellite) -----------------
+
+def test_bench_check_row_is_always_written(tmp_path, monkeypatch):
+    """bench --check must leave a BENCH_pr*.json row for EVERY gate
+    run (PR 14 and 15 gated without recording — a two-PR hole in the
+    trajectory), and a write failure must propagate, not pass."""
+    import bench
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+    monkeypatch.setattr(bench.sys, "argv", ["bench.py", "--check"])
+    (tmp_path / "CHANGES.md").write_text(
+        "- PR 7 (perf_opt): something\n- PR 9 (robustness): more\n")
+    assert bench._pr_number() == 10
+    path = bench.write_bench_row({"metric": "m", "value": 1.0})
+    assert os.path.basename(path) == "BENCH_pr10.json"
+    with open(path) as f:
+        assert json.load(f) == {"metric": "m", "value": 1.0}
+    assert not os.path.exists(path + ".tmp")
+
+    # --pr override wins over CHANGES.md
+    monkeypatch.setattr(bench.sys, "argv", ["bench.py", "--check",
+                                            "--pr", "99"])
+    assert bench._pr_number() == 99
+
+    # no CHANGES.md: fall back to the highest existing BENCH_pr*.json
+    monkeypatch.setattr(bench.sys, "argv", ["bench.py", "--check"])
+    (tmp_path / "CHANGES.md").unlink()
+    assert bench._pr_number() == 11  # BENCH_pr10.json from above + 1
+
+    # an unwritable row is a HARD failure — never a silent pass
+    def boom(*a, **kw):
+        raise OSError("disk full")
+    monkeypatch.setattr(bench.os, "replace", boom)
+    with pytest.raises(OSError, match="disk full"):
+        bench.write_bench_row({"metric": "m", "value": 2.0})

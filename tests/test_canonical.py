@@ -1,0 +1,332 @@
+"""Bucket canonicalization: pad-ladder / quantization bit-parity.
+
+PR 16 collapses near-identical dense trace requests into canonical
+equivalence classes (service/canonical.py): peer counts pad to
+power-of-two ladder rungs with inert filler peers, phase windows
+quantize to the checkpoint grid with exact windows riding as Schedule
+data, and world parameters become runtime operands.  The whole scheme
+is only sound if a canonical run is BIT-IDENTICAL to its exact
+(unpadded, unquantized) solo run — these tests pin that per tick, for
+the grader's non-power-of-two N=10 padded to rung 16, for mixed-n
+drop-off classes, and for composed-world classes with operand jitter.
+Filler peers must never be unstacked into results (the peer-axis twin
+of the fleet's filler-lane invariant).
+"""
+
+import numpy as np
+import pytest
+
+from gossip_protocol_tpu.config import SimConfig
+from gossip_protocol_tpu.core.fleet import CanonicalFleetSimulation
+from gossip_protocol_tpu.core.sim import Simulation
+from gossip_protocol_tpu.core.tick import run_build_count
+from gossip_protocol_tpu.models.segments import (CHECKPOINT_GRID_TICKS,
+                                                 quantize_tick,
+                                                 quantized_plan_signature)
+from gossip_protocol_tpu.service.canonical import (canonical_bucket_key,
+                                                   canonical_drop_active,
+                                                   canonical_supported,
+                                                   ladder_rung)
+
+STATE_FIELDS = ("tick", "in_group", "own_hb", "known", "hb", "ts",
+                "gossip", "gossip_age", "joinreq", "joinrep")
+
+
+def _drop10(seed=1, prob=0.1, open_t=13, close_t=41):
+    """Grader-style dense config: N=10 (non-power-of-two), windowed
+    drop — pads to rung 16."""
+    return SimConfig(max_nnb=10, single_failure=True, drop_msg=True,
+                     msg_drop_prob=prob, seed=seed, total_ticks=60,
+                     fail_tick=20, drop_open_tick=open_t,
+                     drop_close_tick=close_t)
+
+
+def _nodrop(n, seed=1):
+    return SimConfig(max_nnb=n, single_failure=True, drop_msg=False,
+                     seed=seed, total_ticks=60, fail_tick=20)
+
+
+def _assert_lane_bitidentical(ref, lane, ctx):
+    """Per-tick event equality (stronger than every-cut equality) plus
+    counters and the full final state."""
+    assert lane.added.shape == ref.added.shape, ctx
+    assert np.array_equal(ref.added, lane.added), \
+        f"{ctx}: added events diverged"
+    assert np.array_equal(ref.removed, lane.removed), \
+        f"{ctx}: removed events diverged"
+    assert np.array_equal(ref.sent, lane.sent), f"{ctx}: sent"
+    assert np.array_equal(ref.recv, lane.recv), f"{ctx}: recv"
+    for f in STATE_FIELDS:
+        a = np.asarray(getattr(ref.final_state, f))
+        b = np.asarray(getattr(lane.final_state, f))
+        assert np.array_equal(a, b), f"{ctx}: state field {f} diverged"
+
+
+# ---- key algebra ----------------------------------------------------
+
+def test_ladder_rung():
+    assert [ladder_rung(n) for n in (1, 4, 5, 8, 10, 16, 17, 33)] \
+        == [4, 4, 8, 8, 16, 16, 32, 64]
+
+
+def test_quantize_tick_superset():
+    g = CHECKPOINT_GRID_TICKS
+    for lo, hi in [(13, 41), (0, 16), (15, 17), (16, 16)]:
+        ql, qh = quantize_tick(lo, g), quantize_tick(hi, g, up=True)
+        assert ql <= lo and qh >= hi
+        assert ql % g == 0 and qh % g == 0
+    # sentinels pass through
+    assert quantize_tick(-1, g) == -1
+    assert quantize_tick(1 << 30, g, up=True) == 1 << 30
+
+
+def test_canonical_key_collapses_operands_and_jitter():
+    base = _drop10(seed=1, prob=0.1, open_t=13, close_t=41)
+    key = canonical_bucket_key(base, "trace")
+    assert key[0] == "canon"
+    # drop probability is a runtime operand; window jitter within the
+    # grid and seeds collapse too
+    for c in (_drop10(seed=2, prob=0.25, open_t=13, close_t=41),
+              _drop10(seed=3, prob=0.1, open_t=14, close_t=44)):
+        assert canonical_bucket_key(c, "trace") == key
+    # ... but t_remove (baked), a window crossing a grid line, and a
+    # different static plane set split classes
+    assert canonical_bucket_key(
+        base.replace(t_remove=12), "trace") != key
+    assert canonical_bucket_key(
+        _drop10(open_t=31, close_t=41), "trace") != key
+    assert canonical_bucket_key(
+        base.replace(zombie=True), "trace") != key
+
+
+def test_canonical_key_collapses_n_for_dropless():
+    """Drop-off configs share a rung-wide program across REAL n; a
+    drop-on config pins its real n (stream width) in the key."""
+    k10 = canonical_bucket_key(_nodrop(10), "trace")
+    assert canonical_bucket_key(_nodrop(13), "trace") == k10
+    assert canonical_bucket_key(_nodrop(16), "trace") == k10
+    assert canonical_bucket_key(_nodrop(17), "trace") != k10  # rung 32
+    d10 = canonical_bucket_key(_drop10(), "trace")
+    d11 = canonical_bucket_key(
+        _drop10().replace(max_nnb=11), "trace")
+    assert d10 != d11
+
+
+def test_canonical_fallback_and_support():
+    ov = SimConfig(max_nnb=64, model="overlay", single_failure=True,
+                   drop_msg=False, seed=0, total_ticks=64,
+                   fail_tick=30, step_rate=8.0 / 64)
+    assert not canonical_supported(ov, "trace")
+    assert not canonical_supported(_drop10(), "bench")
+    assert canonical_bucket_key(ov, "trace")[0] != "canon"
+    assert canonical_bucket_key(_drop10(), "bench")[0] != "canon"
+
+
+def test_canonical_drop_active_superset():
+    cfg = _drop10(open_t=13, close_t=41)
+    t = np.arange(cfg.total_ticks)
+    exact = (t > 13) & (t <= 41)
+    canon = canonical_drop_active(cfg)
+    assert canon.shape == exact.shape
+    assert np.all(canon[exact]), "quantized window must cover exact"
+    assert not canonical_drop_active(_nodrop(10)).any()
+
+
+# ---- satellite 4: pad-ladder parity ---------------------------------
+
+def test_pad_ladder_parity_n10_rung16():
+    """The grader's N=10 padded to rung 16: three class members with
+    jittered windows and drop probabilities, every lane bit-identical
+    to its exact unpadded solo run at EVERY tick; filler peer rows
+    never surface in results."""
+    members = [_drop10(seed=1, prob=0.1, open_t=13, close_t=41),
+               _drop10(seed=2, prob=0.25, open_t=14, close_t=44),
+               _drop10(seed=3, prob=0.1, open_t=13, close_t=41)]
+    fleet = CanonicalFleetSimulation(members[0])
+    assert fleet.rung == 16
+    res = fleet.run(configs=members)
+    assert res.batch == len(members)
+    for i, c in enumerate(members):
+        ref = Simulation(c).run()
+        lane = res.lanes[i]
+        # filler peers are never unstacked: results are REAL width
+        assert lane.added.shape == (c.total_ticks, 10, 10)
+        assert np.asarray(lane.final_state.known).shape == (10, 10)
+        assert lane.sent.shape[0] == 10
+        _assert_lane_bitidentical(ref, lane, f"lane {i}")
+
+
+@pytest.mark.slow
+def test_pad_ladder_parity_mixed_n_dropless():
+    """One rung-16 drop-off class serving REAL n of 10, 13, and 16 in
+    a single program — per-lane results bit-identical to solo runs at
+    each lane's own width."""
+    members = [_nodrop(10, seed=5), _nodrop(13, seed=6),
+               _nodrop(16, seed=7)]
+    keys = {canonical_bucket_key(c, "trace") for c in members}
+    assert len(keys) == 1
+    fleet = CanonicalFleetSimulation(members[0])
+    res = fleet.run(configs=members)
+    for i, c in enumerate(members):
+        ref = Simulation(c).run()
+        lane = res.lanes[i]
+        assert lane.added.shape == (c.total_ticks, c.n, c.n)
+        _assert_lane_bitidentical(ref, lane, f"lane n={c.n}")
+
+
+@pytest.mark.slow
+def test_pad_ladder_parity_composed_worlds():
+    """Composed-world class (partition + drop): the partition group
+    COUNT and window scalars ride as operands/data, so members with
+    different group counts share one program and still match their
+    solo runs bit-for-bit."""
+    def member(seed, groups, prob):
+        return SimConfig(max_nnb=12, single_failure=True, drop_msg=True,
+                         msg_drop_prob=prob, seed=seed, total_ticks=64,
+                         fail_tick=20, drop_open_tick=13,
+                         drop_close_tick=41, partition_groups=groups,
+                         partition_open_tick=16,
+                         partition_close_tick=32)
+    members = [member(1, 2, 0.1), member(2, 3, 0.2)]
+    assert len({canonical_bucket_key(c, "trace")
+                for c in members}) == 1
+    fleet = CanonicalFleetSimulation(members[0])
+    res = fleet.run(configs=members)
+    for i, c in enumerate(members):
+        _assert_lane_bitidentical(Simulation(c).run(), res.lanes[i],
+                                  f"groups={c.partition_groups}")
+
+
+@pytest.mark.slow
+def test_pad_ladder_parity_latency_plane():
+    """Latency plane: the per-link delay matrix pads with an inert
+    filler value; real-corner delivery ages match solo exactly."""
+    def member(seed):
+        return SimConfig(max_nnb=11, single_failure=True,
+                         drop_msg=False, seed=seed, total_ticks=64,
+                         fail_tick=20, link_latency=3)
+    members = [member(1), member(2)]
+    fleet = CanonicalFleetSimulation(members[0])
+    res = fleet.run(configs=members)
+    for i, c in enumerate(members):
+        _assert_lane_bitidentical(Simulation(c).run(), res.lanes[i],
+                                  f"lat lane {i}")
+
+
+@pytest.mark.slow
+def test_canonical_program_reuse_across_members():
+    """Two launches with different members of one class share the
+    compiled program: zero fresh builds on the second dispatch."""
+    a = _drop10(seed=11, prob=0.11)
+    b = _drop10(seed=12, prob=0.33, open_t=14, close_t=44)
+    fleet = CanonicalFleetSimulation(a)
+    fleet.run(configs=[a])
+    before = run_build_count()
+    fleet.run(configs=[b])
+    assert run_build_count() == before, \
+        "second member dispatch must not rebuild the canonical program"
+
+
+def test_canonical_rejects_non_members():
+    fleet = CanonicalFleetSimulation(_drop10())
+    with pytest.raises(ValueError, match="equivalence class"):
+        fleet.run(configs=[_drop10().replace(t_remove=12)])
+    with pytest.raises(NotImplementedError):
+        fleet.run_bench(seeds=[1])
+    with pytest.raises(NotImplementedError):
+        fleet.launch_leg(seeds=[1])
+
+
+def test_quantized_signature_from_real_config():
+    """The quantized plan signature must derive from the REAL config's
+    phase windows (last_start depends on n), not the rung
+    representative's — members of a mixed-n class agree by
+    quantization, not by accident of width."""
+    s10 = quantized_plan_signature(_nodrop(10))
+    s13 = quantized_plan_signature(_nodrop(13))
+    assert s10 == s13
+    assert s10[0] == "segplan-q"
+
+
+# ---- the serving layer (FleetService(canonicalize=True)) ------------
+
+def _svc():
+    from gossip_protocol_tpu.service import FleetService
+    return FleetService(max_batch=4, max_wait_s=1e9,
+                        canonicalize=True)
+
+
+def test_service_canonical_class_serves_jittered_members_exactly():
+    """Three drop requests that jitter probability and window edges
+    within one quantization cell land in ONE canonical class, build
+    ONE program, and each comes back bit-identical to its exact solo
+    run.  The class map records every absorbed exact bucket key."""
+    from gossip_protocol_tpu.service import bucket_key
+    cfgs = [_drop10(seed=3, prob=0.08, open_t=13, close_t=41),
+            _drop10(seed=4, prob=0.12, open_t=9, close_t=44),
+            _drop10(seed=5, prob=0.10, open_t=12, close_t=47)]
+    assert len({bucket_key(c, "trace") for c in cfgs}) == 3
+    assert len({canonical_bucket_key(c, "trace") for c in cfgs}) == 1
+    svc = _svc()
+    b0 = run_build_count()
+    handles = [svc.submit(c) for c in cfgs]
+    svc.drain()
+    assert run_build_count() - b0 == 1
+    for c, h in zip(cfgs, handles):
+        ref = Simulation(c).run()
+        _assert_lane_bitidentical(ref, h.result(), f"seed={c.seed}")
+    classes = svc.cache.class_map()
+    assert len(classes) == 1
+    (cls,) = classes.values()
+    assert cls["members"] == {bucket_key(c, "trace") for c in cfgs}
+    assert cls["hits"] >= 1
+    st = svc.stats()
+    assert st["canonicalize"] is True
+    assert st["cache"]["class_member_buckets"] == 3
+
+
+def test_service_canonical_warm_registers_class_member():
+    """warm() on a canonical service records the warmed config's exact
+    bucket key as a class member and leaves the bucket build-free on
+    the next dispatch."""
+    from gossip_protocol_tpu.service import bucket_key
+    cfg = _drop10(seed=7)
+    svc = _svc()
+    svc.warm(cfg)
+    classes = svc.cache.class_map()
+    assert bucket_key(cfg, "trace") in next(iter(classes.values()))["members"]
+    b0 = run_build_count()
+    h = svc.submit(_drop10(seed=8, prob=0.11))
+    svc.drain()
+    assert run_build_count() - b0 == 0
+    _assert_lane_bitidentical(Simulation(_drop10(seed=8, prob=0.11)).run(),
+                              h.result(), "warmed member")
+
+
+def test_service_canonical_falls_back_to_exact_for_overlay():
+    """Unsupported shapes (overlay) keep EXACT buckets even on a
+    canonical service — the scheduler's bucket routing hands them the
+    plain ``bucket_key`` and no class entry appears.  (The exact
+    dispatch path itself is exercised by the overlay service tests;
+    this pins only the ROUTING so no overlay program compiles here.)"""
+    from gossip_protocol_tpu.service import bucket_key
+    ocfg = SimConfig(max_nnb=64, model="overlay", single_failure=False,
+                     drop_msg=False, seed=2, total_ticks=48,
+                     churn_rate=0.25, rejoin_after=16, step_rate=8.0 / 64)
+    svc = _svc()
+    assert not canonical_supported(ocfg, "trace")
+    key = svc._bucket(ocfg, "trace")
+    assert key == bucket_key(ocfg, "trace")
+    assert key[0] != "canon"
+    assert svc.cache.class_map() == {}
+    svc.drain()
+
+
+def test_service_canonicalize_rejects_checkpoint_and_mesh():
+    from gossip_protocol_tpu.service import FleetService
+    with pytest.raises(ValueError, match="checkpoint"):
+        FleetService(canonicalize=True, checkpoint_every=16)
+    class _FakeMesh:
+        pass
+    with pytest.raises(ValueError, match="single-device"):
+        FleetService(canonicalize=True, mesh=_FakeMesh())

@@ -118,8 +118,13 @@ def test_dense_mega_envelope():
     assert not dense_mega_supported(big.replace(max_nnb=2048))
 
 
+@pytest.mark.slow
 def test_dense_mega_reduced_ticks_above_512():
-    """The S=8 launch shape (N > 512) replays the per-tick path too."""
+    """The S=8 launch shape (N > 512) replays the per-tick path too.
+
+    Slow tier: two n=576 compiles (~50 s on a 1-core container) —
+    the S<8 mega parity stays tier-1 via the scenario matrix above.
+    """
     import jax
 
     from gossip_protocol_tpu.core.tick import make_tick

@@ -126,8 +126,11 @@ def test_fleet_overlay_parity(make_cfg):
         lane.final_coverage()
 
 
-@pytest.mark.parametrize("make_cfg", [_overlay_churn, _overlay_drop],
-                         ids=["churn", "drop10"])
+@pytest.mark.parametrize(
+    "make_cfg",
+    [pytest.param(_overlay_churn, marks=pytest.mark.slow),
+     _overlay_drop],
+    ids=["churn", "drop10"])
 def test_grid_fleet_kernel_parity(make_cfg):
     """The batched grid kernel (leading batch grid dimension) replays
     each lane of the single-lane grid run bit-for-bit — and therefore

@@ -74,7 +74,14 @@ def _compare(cfg, length, start_tick=0, state=None):
     return fg
 
 
-@pytest.mark.parametrize("scenario", ["churn", "fail_rejoin", "drop10"])
+# churn is the tier-1 representative (most distinct segment flags);
+# the other scenarios move to the slow lap to keep tier-1 inside its
+# 870 s wrapper on 1-core containers (~20-25 s of compiles each)
+@pytest.mark.parametrize("scenario", [
+    "churn",
+    pytest.param("fail_rejoin", marks=pytest.mark.slow),
+    pytest.param("drop10", marks=pytest.mark.slow),
+])
 def test_segmented_run_bitwise_equals_xla(scenario):
     cfg = _cfg(scenario)
     plan = plan_segments(cfg, cfg.total_ticks, 0, GRID_TICKS)
