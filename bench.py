@@ -525,6 +525,11 @@ def main():
                 "requests": sv["requests"],
                 "devices": sv["devices"],
                 "pipeline": sv["pipeline"],
+                # the PR-17 ring plane: configured in-flight depth and
+                # how often a dispatch found its ring full (absent in
+                # pre-PR-17 jsons; the trajectory renders "-")
+                "pipeline_depth": sv["pipeline_depth"],
+                "ring_stalls": sv["ring_stalls"],
                 "speedup_vs_sequential": sv["speedup_vs_sequential"],
                 "aggregate_node_ticks_per_s":
                     sv["aggregate_node_ticks_per_s"],

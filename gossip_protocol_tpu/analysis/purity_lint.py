@@ -65,6 +65,24 @@ PURE_PATH_MODULES = (
     "gossip_protocol_tpu/models/scenarios.py",
 )
 
+#: (module, function) pairs whose BODIES order the deterministic
+#: harvest of the per-bucket in-flight rings (PR 17): ring creation
+#: order + FIFO within each ring must stay a pure function of the
+#: submit/flush sequence, so chaos/elastic digest replays hold at
+#: every pipeline_depth.  The whole scheduler module legitimately
+#: reads wall clock elsewhere (deadlines, queue-age batching), so the
+#: no-wall-clock rule is scoped to exactly these functions rather
+#: than the file.  ``_harvest_ready`` is included deliberately: its
+#: readiness PROBE is wall-dependent, but that dependence must enter
+#: only through ``PendingFleet.is_ready()`` — a direct ``time.*``
+#: call (or an RNG tiebreak) in the ordering logic itself is the bug
+#: class this guards against.
+RING_ORDER_FUNCS = {
+    "gossip_protocol_tpu/service/scheduler.py": (
+        "_ring_key", "_inflight_batches", "_pop_oldest_inflight",
+        "_abort_inflight", "resolve_inflight", "_harvest_ready"),
+}
+
 #: (module, function) pairs PERF §11 declares host-numpy-only:
 #: schedule builders, host lane stacking, checkpoint snapshot/stitch
 HOST_STAGING_FUNCS = {
@@ -241,10 +259,23 @@ def _time_aliases(tree) -> tuple[set, set]:
     return mods, names
 
 
-def _check_pure_paths(tree, lines, relfile, allow) -> list[Finding]:
+def _check_pure_paths(tree, lines, relfile, allow,
+                      funcs=None) -> list[Finding]:
+    """``funcs=None`` checks the whole module (the PURE_PATH_MODULES
+    contract); a tuple of names scopes the rule to those function
+    bodies (the RING_ORDER_FUNCS contract — modules that legitimately
+    read wall clock elsewhere)."""
     out = []
     time_mods, time_names = _time_aliases(tree)
-    for node in ast.walk(tree):
+    if funcs is None:
+        nodes = ast.walk(tree)
+    else:
+        nodes = (sub for node in ast.walk(tree)
+                 if isinstance(node, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+                 and node.name in funcs
+                 for sub in ast.walk(node))
+    for node in nodes:
         if not isinstance(node, ast.Call):
             continue
         chain = _attr_chain(node.func)
@@ -503,6 +534,10 @@ def lint(rules=None) -> list[Finding]:
         for rel in PURE_PATH_MODULES:
             tree, lines = _read_lines(os.path.join(REPO_ROOT, rel))
             findings += _check_pure_paths(tree, lines, rel, allow)
+        for rel, funcs in RING_ORDER_FUNCS.items():
+            tree, lines = _read_lines(os.path.join(REPO_ROOT, rel))
+            findings += _check_pure_paths(tree, lines, rel, allow,
+                                          funcs=funcs)
     if want("host-staging-is-numpy"):
         for rel, funcs in HOST_STAGING_FUNCS.items():
             tree, lines = _read_lines(os.path.join(REPO_ROOT, rel))
@@ -526,7 +561,8 @@ def raw_findings(rule: str, relfile: str) -> list[Finding]:
     be dropped), whatever rule the entry belongs to."""
     tree, lines = _read_lines(os.path.join(REPO_ROOT, relfile))
     if rule == "no-wall-clock-in-pure-paths":
-        return _check_pure_paths(tree, lines, relfile, [])
+        return _check_pure_paths(tree, lines, relfile, [],
+                                 funcs=RING_ORDER_FUNCS.get(relfile))
     if rule == "host-staging-is-numpy":
         return _check_host_staging(
             tree, lines, relfile, HOST_STAGING_FUNCS.get(relfile, ()),
@@ -541,14 +577,17 @@ def raw_findings(rule: str, relfile: str) -> list[Finding]:
 # ---- fixture entry points (used by tests/test_analysis.py) -----------
 def lint_source(src: str, relfile: str = "<fixture>.py",
                 rule: str = "no-wall-clock-in-pure-paths",
-                staging_funcs=()) -> list[Finding]:
+                staging_funcs=(), pure_funcs=None) -> list[Finding]:
     """Run ONE rule over an in-memory source string — the violation
     fixtures prove each rule actually fires without planting broken
-    code in the tree."""
+    code in the tree.  ``pure_funcs`` scopes the no-wall-clock rule
+    to named function bodies (the RING_ORDER_FUNCS form); None keeps
+    the whole-module form."""
     tree = ast.parse(src)
     lines = src.splitlines()
     if rule == "no-wall-clock-in-pure-paths":
-        return _check_pure_paths(tree, lines, relfile, [])
+        return _check_pure_paths(tree, lines, relfile, [],
+                                 funcs=pure_funcs)
     if rule == "host-staging-is-numpy":
         return _check_host_staging(tree, lines, relfile,
                                    tuple(staging_funcs), [])

@@ -693,7 +693,21 @@ class PendingFleet:
     than synchronous on CPU: two big programs run concurrently on the
     shared thread pool and the fetch of k queues behind k+1.)
     ``pack_seconds`` at construction covers staging only; the final
-    pack cost (staging + dispatch call) is on ``FleetResult``."""
+    pack cost (staging + dispatch call) is on ``FleetResult``.
+
+    Instances are INDEPENDENT ring slots (the PR 17 per-bucket
+    in-flight rings stack ``pipeline_depth`` of them per bucket, any
+    mix of buckets service-wide): every launch closes over its own
+    staging state and result box, the donated placed inputs a mesh
+    run wrapper parks on the shared program (``run.held``) are popped
+    inside the SAME ``start()`` call that parked them (the scheduler
+    starts batches one at a time on the host thread, so no window
+    exists for one slot to take another's refs), and each slot's
+    ``hold`` keeps its own donated buffers alive until its own
+    resolve.  Nothing about staging, starting, waiting on, or
+    resolving one slot reads or writes another's state — k
+    concurrently started programs are safe (XLA serializes or
+    overlaps them as the backend allows)."""
 
     def __init__(self, resolve_fn, pack_seconds: float, hold=None,
                  start_fn=None, wait_fn=None, probe_fn=None):

@@ -72,7 +72,8 @@ def warm_service(svc: FleetService, templates: Sequence[Template]) -> None:
 def probe_capacity_rps(templates: Sequence[Template],
                        n_requests: int = 48, max_batch: int = 8,
                        seed: int = 0, warm_lap: bool = True,
-                       mesh=None) -> float:
+                       mesh=None,
+                       pipeline_depth: Optional[int] = None) -> float:
     """Closed-loop burst probe: all ``n_requests`` at t=0, drain; the
     achieved completion rate is the service's max sustainable
     throughput for this catalog — the ladder's 1.0x anchor.  With
@@ -83,7 +84,8 @@ def probe_capacity_rps(templates: Sequence[Template],
     laps = (0, 1) if warm_lap else (1,)
     rate = 0.0
     for lap in laps:
-        svc = FleetService(max_batch=max_batch, mesh=mesh)
+        svc = FleetService(max_batch=max_batch, mesh=mesh,
+                           pipeline_depth=pipeline_depth)
         warm_service(svc, templates)
         sched = make_schedule(templates, n_requests, pattern,
                               seed=seed + lap)
@@ -101,7 +103,8 @@ def measure_point(templates: Sequence[Template], n_requests: int,
                   early_flush: Optional[bool] = None,
                   tenant_quota: Optional[int] = None,
                   max_queue_depth: Optional[int] = None,
-                  mesh=None) -> dict:
+                  mesh=None,
+                  pipeline_depth: Optional[int] = None) -> dict:
     """One wall-paced open-loop run at one offered load; returns the
     load point's row.  Raises on any non-terminal handle or any
     failure that is not a typed load outcome (deadline expiry /
@@ -115,7 +118,8 @@ def measure_point(templates: Sequence[Template], n_requests: int,
                           class_mix=eff_slo.class_mix())
     svc = FleetService(max_batch=max_batch, max_wait_s=max_wait_s,
                        slo=eff_slo, tenant_quota=tenant_quota,
-                       max_queue_depth=max_queue_depth, mesh=mesh)
+                       max_queue_depth=max_queue_depth, mesh=mesh,
+                       pipeline_depth=pipeline_depth)
     # warm before the clock starts: programs are process-cached after
     # the capacity probe, but warm() also seeds the per-bucket wall
     # EWMAs the deadline-aware early flush reads — a cold estimate
@@ -192,6 +196,8 @@ def measure_point(templates: Sequence[Template], n_requests: int,
         "deadline_miss_rate": round(missed / terminal, 4)
         if terminal else 0.0,
         "mean_occupancy": stats["mean_occupancy"],
+        "pipeline_depth": stats["pipeline_depth"],
+        "ring_stalls": stats["ring_stalls"],
         "slo_early_flushes": stats["slo_early_flushes"],
         "max_lag_s": round(rec["max_lag_s"], 3),
         "span_s": round(sched.span_s, 3),
@@ -244,6 +250,47 @@ def sweep(templates: Sequence[Template], n_requests: int,
         "saturation_offered_rps": saturation,
         "max_achieved_rps": max(r["achieved_rps"] for r in rows),
     }
+
+
+def effective_saturation(row: dict) -> float:
+    """A ladder's saturation point as a comparable number: the offered
+    rps of the first saturated point, or +inf when the ladder never
+    saturated (absorbing every offered load is strictly better than
+    saturating at any finite one)."""
+    sat = row.get("saturation_offered_rps")
+    return float("inf") if sat is None else float(sat)
+
+
+def depth_ladder(templates: Sequence[Template], n_probe: int,
+                 n_point: int, seed: int, slo: SLOPolicy,
+                 fracs: Sequence[float],
+                 depths: Sequence[int] = (1, 2, 4),
+                 max_batch: int = 8) -> dict:
+    """The PR 17 headline measurement: the SAME open-loop ladder at
+    pipeline depth 1 / 2 / 4.  One capacity probe (at depth 1) anchors
+    the offered rates, and each point reuses the same seed across
+    depths — identical arrival schedules, so the saturation shift is
+    the depth's doing, not the schedule's.  Each row also records the
+    depth's own closed-loop burst probe and the ring back-pressure
+    (``ring_stalls``) the sweep's points accumulated."""
+    cap = probe_capacity_rps(templates, n_requests=n_probe,
+                             max_batch=max_batch, pipeline_depth=1)
+    rows = []
+    for d in depths:
+        closed = probe_capacity_rps(templates, n_requests=n_probe,
+                                    max_batch=max_batch,
+                                    pipeline_depth=d)
+        sw = sweep(templates, n_point, cap, seed=seed, slo=slo,
+                   fracs=fracs, max_batch=max_batch, pipeline_depth=d)
+        rows.append({
+            "depth": d,
+            "closed_loop_rps": round(closed, 3),
+            "saturation_offered_rps": sw["saturation_offered_rps"],
+            "max_achieved_rps": sw["max_achieved_rps"],
+            "points": sw["points"],
+        })
+    return {"anchor_capacity_rps": round(cap, 3),
+            "load_fracs": list(fracs), "rows": rows}
 
 
 def slo_ab(templates: Sequence[Template], n_requests: int,
@@ -402,6 +449,21 @@ def load_openloop_bench(smoke: bool = False, seed: int = 20260804,
             f"SLO A/B regression: deadline-miss rate with early flush "
             f"ON ({ab['miss_rate_on']}) is not strictly below OFF "
             f"({ab['miss_rate_off']}) at {ab['offered_rps']} rps")
+    # the depth sweep (PR 17): the same ladder at pipeline depth
+    # 1/2/4 — the headline gate is that depth 2 holds off saturation
+    # at least as long as depth 1 (enforced on full runs; smoke
+    # ladders are too small to saturate meaningfully)
+    ds = depth_ladder(templates, n_probe, max(12, n_point // 3),
+                      seed=seed + 400, slo=slo, fracs=fracs)
+    by_depth = {r["depth"]: r for r in ds["rows"]}
+    if not smoke and 1 in by_depth and 2 in by_depth \
+            and effective_saturation(by_depth[2]) \
+            < effective_saturation(by_depth[1]):
+        raise RuntimeError(
+            f"depth-sweep regression: depth-2 saturates at "
+            f"{by_depth[2]['saturation_offered_rps']} rps, below "
+            f"depth-1's {by_depth[1]['saturation_offered_rps']} — "
+            f"per-bucket rings must not LOWER the saturation point")
     entry = {
         "pattern": "poisson",
         "slo_classes": {name: {"deadline_s": c.deadline_s,
@@ -410,6 +472,7 @@ def load_openloop_bench(smoke: bool = False, seed: int = 20260804,
         **sw,
         "slo_ab": ab,
         "replay_check": rc,
+        "depth_sweep": ds,
         "bench_wall_s": round(now() - t0, 1),
     }
     # lane-mesh load point (PR 8 satellite): the knee-load point once

@@ -126,11 +126,13 @@ def run_sequential(trace) -> tuple[list, float]:
 
 def run_service(trace, max_batch: int = 8,
                 service: FleetService | None = None,
-                pipeline: bool | None = None
+                pipeline: bool | None = None,
+                pipeline_depth: int | None = None
                 ) -> tuple[list, FleetService, float]:
     """The serving leg: submit the stream, drain, collect results."""
     svc = service if service is not None else FleetService(
-        max_batch=max_batch, pipeline=pipeline)
+        max_batch=max_batch, pipeline=pipeline,
+        pipeline_depth=pipeline_depth)
     t0 = time.perf_counter()
     handles = [svc.submit(tpl.cfg, seed=seed, mode=tpl.mode)
                for tpl, seed in trace]
@@ -231,7 +233,8 @@ def node_ticks(trace) -> int:
 def replay(templates: list[Template], seeds_per_template: int,
            max_batch: int = 8, check_parity: bool = True,
            mesh=None, sequential=None, return_legs: bool = False,
-           pipeline: bool | None = None):
+           pipeline: bool | None = None,
+           pipeline_depth: int | None = None):
     """Full A/B replay; returns the service-metrics dict for BENCH.
 
     Raises on any per-request parity mismatch — a serving layer that
@@ -253,7 +256,8 @@ def replay(templates: list[Template], seeds_per_template: int,
     """
     trace = build_trace(templates, seeds_per_template)
     svc = FleetService(max_batch=max_batch, mesh=mesh,
-                       pipeline=pipeline)
+                       pipeline=pipeline,
+                       pipeline_depth=pipeline_depth)
     warm(trace, svc)
     if sequential is None:
         seq_results, seq_wall = run_sequential(trace)
@@ -303,6 +307,8 @@ def replay(templates: list[Template], seeds_per_template: int,
         "latency_p95_s": stats["latency_p95_s"],
         "mean_occupancy": stats["mean_occupancy"],
         "pipeline": stats["pipeline"],
+        "pipeline_depth": stats["pipeline_depth"],
+        "ring_stalls": stats["ring_stalls"],
         "mean_pack_s": stats["mean_pack_s"],
         "mean_device_wait_s": stats["mean_device_wait_s"],
         "mean_fetch_s": stats["mean_fetch_s"],
@@ -328,7 +334,8 @@ def chaos_replay(templates: list[Template], seeds_per_template: int,
                  fault_rate: float = 0.12, device_loss_at="mid",
                  max_retries: int = 4, backoff_base_s: float = 0.01,
                  sequential=None, return_legs: bool = False,
-                 pipeline: bool | None = None):
+                 pipeline: bool | None = None,
+                 pipeline_depth: int | None = None):
     """The chaos acceptance harness: the mixed replay under a SEEDED
     fault schedule (service/faults.py) plus one mid-replay device
     loss, with the gate enforced in-line:
@@ -383,7 +390,7 @@ def chaos_replay(templates: list[Template], seeds_per_template: int,
         # sequence, so attempt indices — and with them the fault
         # schedule — are still a pure function of submit order.
         breaker=BreakerPolicy(reset_after_s=float("inf")),
-        pipeline=pipeline)
+        pipeline=pipeline, pipeline_depth=pipeline_depth)
     warm(trace, svc)
     if sequential is None:
         seq_results, seq_wall = run_sequential(trace)
@@ -452,6 +459,8 @@ def chaos_replay(templates: list[Template], seeds_per_template: int,
         "mean_occupancy": stats["mean_occupancy"],
         "dispatches": stats["dispatches"],
         "pipeline": stats["pipeline"],
+        "pipeline_depth": stats["pipeline_depth"],
+        "ring_stalls": stats["ring_stalls"],
         "breaker_open_buckets": stats["breaker_open_buckets"],
     }
     if return_legs:
@@ -466,7 +475,8 @@ def elastic_replay(templates: list[Template], seeds_per_template: int,
                    device_return_at="after", max_retries: int = 4,
                    backoff_base_s: float = 0.01, sequential=None,
                    return_legs: bool = False,
-                   pipeline: bool | None = None):
+                   pipeline: bool | None = None,
+                   pipeline_depth: int | None = None):
     """The elastic acceptance harness (PR 8): the mixed replay served
     as RESUMABLE LEGS (``checkpoint_every`` segment budget) under one
     seeded device loss AND one device return, with the gate enforced
@@ -519,7 +529,8 @@ def elastic_replay(templates: list[Template], seeds_per_template: int,
         # same determinism pins as chaos_replay: no time-based flushes,
         # an opened bucket stays deterministically quarantined
         breaker=BreakerPolicy(reset_after_s=float("inf")),
-        checkpoint_every=checkpoint_every, pipeline=pipeline)
+        checkpoint_every=checkpoint_every, pipeline=pipeline,
+        pipeline_depth=pipeline_depth)
     warm(trace, svc)
     if sequential is None:
         seq_results, seq_wall = run_sequential(trace)
@@ -624,6 +635,8 @@ def elastic_replay(templates: list[Template], seeds_per_template: int,
         "mean_occupancy": stats["mean_occupancy"],
         "dispatches": stats["dispatches"],
         "pipeline": stats["pipeline"],
+        "pipeline_depth": stats["pipeline_depth"],
+        "ring_stalls": stats["ring_stalls"],
     }
     if return_legs:
         return metrics, (seq_results, seq_wall)

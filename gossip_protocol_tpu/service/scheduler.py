@@ -115,12 +115,13 @@ PAD_POLICIES = ("full", "pow2", "none")
 
 @dataclass
 class _Inflight:
-    """One launched-but-unresolved dispatch (the pipeline's depth-1
-    buffer): the device program is running; the host is free to pack
-    the next bucket.  Resolution (block + fetch + validate + complete
-    the handles) happens when the NEXT batch launches or at the end of
-    a ``flush``/``drain`` — a deterministic schedule, so chaos replays
-    stay a pure function of submit order."""
+    """One launched-but-unresolved dispatch (one slot of a bucket's
+    in-flight ring): the device program is running; the host is free
+    to pack the next bucket.  Resolution (block + fetch + validate +
+    complete the handles) happens when a later dispatch displaces this
+    slot from a full ring, or at the end of a ``flush``/``drain`` — a
+    deterministic schedule, so chaos replays stay a pure function of
+    submit order."""
 
     key: tuple
     reqs: list = field(repr=False)
@@ -167,6 +168,7 @@ class FleetService:
                  default_deadline_s: Optional[float] = None,
                  degrade_to_solo: bool = True, sleep=time.sleep,
                  pipeline: Optional[bool] = None,
+                 pipeline_depth: Optional[int] = None,
                  slo: Optional[SLOPolicy] = None,
                  tenant_quota: Optional[int] = None,
                  pump_harvest: Optional[bool] = None,
@@ -280,13 +282,13 @@ class FleetService:
         #: behavior); False pins it off for deterministic virtual-clock
         #: traffic runs (service/traffic.py) even without an injector
         self.pump_harvest = pump_harvest
-        #: pipelined dispatch (the PR 6 tentpole, default ON): a
-        #: dispatch STAGES its batch, waits for the previous in-flight
-        #: batch's program to finish, dispatches its own program onto
-        #: the now-idle devices, and only then fetches + completes the
-        #: previous batch — so staging overlaps the previous
-        #: execution, fetching overlaps the next, and no two fleet
-        #: programs ever compete for the cores.  ``False`` is the
+        #: pipelined dispatch (the PR 6 tentpole, default ON;
+        #: generalized to per-bucket rings by PR 17): a dispatch
+        #: STAGES its batch, waits for the oldest in-flight batch in
+        #: its ring ONLY when the ring is full, dispatches its own
+        #: program, and only then fetches + completes the displaced
+        #: batch — so staging overlaps earlier executions, fetching
+        #: overlaps the next.  ``False`` is the
         #: synchronous beat (launch + resolve inside each dispatch) —
         #: kept because its un-overlapped timing is the clean
         #: device-wait-fraction measurement (under overlap the host
@@ -295,7 +297,33 @@ class FleetService:
         #: (scripts/service_smoke.py pipeline; docs/PERF.md §11 has
         #: the measured steady-state comparison).
         self.pipeline = True if pipeline is None else bool(pipeline)
-        self._inflight: Optional[_Inflight] = None
+        if pipeline_depth is not None and int(pipeline_depth) < 1:
+            raise ValueError(f"pipeline_depth must be >= 1 or None, "
+                             f"got {pipeline_depth}")
+        #: in-flight ring depth (PR 17): how many launched-but-
+        #: unresolved batches each BUCKET may hold.  At depth 1 every
+        #: bucket shares ONE service-wide slot — bit-compatible with
+        #: the PR 6 beat (stage, wait previous, start, resolve
+        #: previous), so depth-1 replays are digest-identical to the
+        #: single-slot scheduler.  At depth >= 2 each bucket owns its
+        #: own ring: independent buckets overlap on the device instead
+        #: of serializing through one beat, and a bucket's own
+        #: dispatches stack ``pipeline_depth`` deep before the oldest
+        #: is waited on — hiding the residual per-dispatch host work
+        #: behind that many executions (docs/PERF.md §11).
+        self.pipeline_depth = 2 if pipeline_depth is None \
+            else int(pipeline_depth)
+        #: the in-flight rings: ring key -> FIFO deque of _Inflight
+        #: (oldest launched first).  Ring key is ``()`` (one shared
+        #: ring) at depth 1, the queue/bucket key at depth >= 2.
+        #: Iteration order (ring creation order, FIFO within a ring)
+        #: is the deterministic harvest order — a pure function of the
+        #: submit/flush sequence, never of wall time.
+        self._rings: dict[tuple, deque] = {}
+        #: dispatches that found their ring FULL and had to displace
+        #: (wait on) the oldest in-flight batch before starting — the
+        #: pipeline back-pressure counter surfaced by stats()
+        self._ring_stalls = 0
         self._has_deadlines = False   # gates the per-pump queue scan
         self._attempts = 0      # dispatch-attempt counter = the fault
         #                         schedule's index (service/faults.py)
@@ -379,6 +407,7 @@ class FleetService:
             store.journal.meta({
                 "max_batch": max_batch, "pad_policy": pad_policy,
                 "pipeline": self.pipeline,
+                "pipeline_depth": self.pipeline_depth,
                 "checkpoint_every": checkpoint_every,
                 "checkpoint_every_s": checkpoint_every_s,
                 "mesh_devices": self.n_devices,
@@ -534,9 +563,11 @@ class FleetService:
         bucket whose tightest deadline minus its estimated dispatch
         wall says a partial batch must dispatch NOW to make its SLO
         (:meth:`_should_flush_early`).  A pump that made no dispatch
-        also HARVESTS a finished in-flight batch (non-blocking
-        ``is_ready`` check), so a poll-driven caller sees completions
-        during idle periods without forcing a flush — except when
+        also HARVESTS finished in-flight batches (non-blocking
+        ``is_ready`` check on each ring's oldest slot,
+        :meth:`_harvest_ready`), so a poll-driven caller sees
+        completions during idle periods without forcing a flush —
+        except when
         :meth:`_harvest_enabled` says no: under an active fault
         injector (a readiness check is wall-time-dependent, and a
         fault surfacing at resolve would consume retry attempt
@@ -569,10 +600,8 @@ class FleetService:
                 self._early_flushes += 1
                 self._dispatch(key)
                 n += 1
-        if n == 0 and self._harvest_enabled() \
-                and self._inflight is not None \
-                and self._inflight.pending.is_ready():
-            self.resolve_inflight()
+        if n == 0 and self._harvest_enabled():
+            self._harvest_ready()
         return n
 
     def _pump_order(self) -> list:
@@ -690,7 +719,7 @@ class FleetService:
             return n
         while True:
             keys = [k for k in self._queues if self._queues[k]]
-            if not keys and self._inflight is None:
+            if not keys and not any(self._rings.values()):
                 break
             for key in keys:
                 while self._queues.get(key):
@@ -713,9 +742,38 @@ class FleetService:
 
     @property
     def in_flight(self) -> int:
-        """Requests launched on device but not yet resolved."""
-        return len(self._inflight.reqs) if self._inflight is not None \
-            else 0
+        """Requests launched on device but not yet resolved (summed
+        over every bucket's in-flight ring)."""
+        return sum(len(i.reqs) for i in self._inflight_batches())
+
+    def _ring_key(self, key: tuple) -> tuple:
+        """The ring a dispatch's in-flight slot lives in: one shared
+        ring (``()``) at depth 1 — exactly the PR 6 service-wide slot,
+        so any bucket's dispatch displaces any other's — the dispatch's
+        own queue key at depth >= 2, so only same-bucket dispatches
+        queue behind each other and independent buckets overlap."""
+        return () if self.pipeline_depth == 1 else key
+
+    def _inflight_batches(self) -> list:
+        """Every in-flight batch, in the deterministic harvest order:
+        ring creation order, oldest-launched first within a ring — a
+        pure function of the submit/flush sequence (no wall clock, no
+        readiness probe), which is what keeps chaos/elastic digest
+        replays depth-stable."""
+        return [i for ring in self._rings.values() for i in ring]
+
+    def _pop_oldest_inflight(self) -> Optional[_Inflight]:
+        """Detach the next in-flight batch in harvest order (pruning
+        emptied rings); None when nothing is in flight."""
+        for rkey in list(self._rings):
+            ring = self._rings[rkey]
+            if ring:
+                infl = ring.popleft()
+                if not ring:
+                    del self._rings[rkey]
+                return infl
+            del self._rings[rkey]
+        return None
 
     def __enter__(self):
         return self
@@ -827,13 +885,14 @@ class FleetService:
     def _dispatch(self, key: tuple) -> None:
         """Pop one batch and serve it.  Synchronous mode resolves it
         ATOMICALLY before returning (the PR-5 contract); pipelined
-        mode may leave the batch IN FLIGHT (tracked in
-        ``self._inflight``), to be resolved when the next batch
-        launches or the flush ends — either way every popped request
-        reaches a terminal state by the time ``flush()``/``drain()``
-        returns.  Only non-Exception escapes (KeyboardInterrupt,
-        SystemExit) re-queue still-unresolved requests at the queue
-        front and propagate."""
+        mode may leave the batch IN FLIGHT (a slot in its bucket's
+        ring, ``self._rings``), to be resolved when a later dispatch
+        displaces it from a full ring, an idle pump harvests it, or
+        the flush ends — either way every popped request reaches a
+        terminal state by the time ``flush()``/``drain()`` returns.
+        Only non-Exception escapes (KeyboardInterrupt, SystemExit)
+        re-queue still-unresolved requests at the queue front and
+        propagate."""
         q = self._queues[key]
         reqs = [q.popleft() for _ in range(min(len(q), self.capacity))]
         for r in reqs:
@@ -848,14 +907,13 @@ class FleetService:
         except BaseException:
             # backstop requeue, DEDUPED: the pipelined path's inner
             # handlers may already have requeued these requests (and
-            # aborted the in-flight batch) before re-raising — a
+            # aborted the in-flight rings) before re-raising — a
             # request is put back only if it is still unresolved AND
             # not already waiting in the queue or riding in flight,
             # so an interrupted flush can be flushed again without
             # duplicate queue entries
-            infl = self._inflight
-            keep = {r.rid for r in infl.reqs} if infl is not None \
-                else set()
+            keep = {r.rid for i in self._inflight_batches()
+                    for r in i.reqs}
             queued = {r.rid for r in q}
             unresolved = [r for r in reqs if r.rid in self._handles
                           and r.rid not in keep and r.rid not in queued]
@@ -885,19 +943,51 @@ class FleetService:
         q.extendleft(reversed(back))
 
     def _abort_inflight(self) -> None:
-        """Re-queue an in-flight batch (non-Exception escape path)."""
-        infl, self._inflight = self._inflight, None
-        if infl is not None:
+        """Re-queue every in-flight batch, all rings (non-Exception
+        escape path)."""
+        while True:
+            infl = self._pop_oldest_inflight()
+            if infl is None:
+                return
             self._requeue_unresolved(infl.key, infl.reqs)
 
     def resolve_inflight(self) -> None:
-        """Resolve the in-flight batch, if any: block until its
-        program completes, fetch + validate, and terminally resolve
-        its handles (retrying / degrading on failure exactly like a
-        synchronous dispatch)."""
-        infl, self._inflight = self._inflight, None
-        if infl is not None:
+        """Resolve every in-flight batch, all rings, in the
+        deterministic harvest order: block until each program
+        completes, fetch + validate, and terminally resolve its
+        handles (retrying / degrading on failure exactly like a
+        synchronous dispatch).  Each batch is detached from its ring
+        BEFORE resolving, so a non-Exception escape mid-resolve leaves
+        the not-yet-resolved batches still registered in flight."""
+        while True:
+            infl = self._pop_oldest_inflight()
+            if infl is None:
+                return
             self._resolve(infl)
+
+    def _harvest_ready(self) -> int:
+        """The idle-pump harvest, generalized to the rings: resolve
+        every ring HEAD whose program reports ready (non-blocking
+        ``PendingFleet.is_ready``), repeating until no head is ready —
+        only a ring's oldest slot may be harvested, so within-bucket
+        resolution order stays FIFO even though readiness is polled.
+        Returns batches resolved.  Wall-dependent by nature (the
+        readiness probe), which is why ``_harvest_enabled`` gates it
+        off under a fault injector or ``pump_harvest=False``."""
+        done = 0
+        progressed = True
+        while progressed:
+            progressed = False
+            for rkey in list(self._rings):
+                ring = self._rings.get(rkey)
+                if ring and ring[0].pending.is_ready():
+                    infl = ring.popleft()
+                    if not ring:
+                        self._rings.pop(rkey, None)
+                    self._resolve(infl)
+                    done += 1
+                    progressed = True
+        return done
 
     # ---- resilient dispatch (service/resilience.py) ------------------
     def _serve_batch(self, key: tuple, reqs: list) -> None:
@@ -918,18 +1008,26 @@ class FleetService:
                                 last_err=err, last_idx=idx)
 
     def _serve_batch_pipelined(self, key: tuple, reqs: list) -> None:
-        """Pipelined dispatch, ordered stage -> resolve-prev ->
-        dispatch: STAGE this batch's lanes (host packing + the tiny
-        device staging programs) while the PREVIOUS in-flight batch's
-        program executes, then resolve the previous batch, then
-        dispatch this batch's program.  Staging is the host work that
-        used to serialize with execution — overlapping it is what
-        breaks the host-bound serving ceiling (docs/PERF.md §11).
-        The big program itself is deliberately NOT dispatched until
-        the previous batch resolves: two fleet programs running
-        concurrently contend for the same cores and the previous
-        batch's result fetch queues behind the new program — measured
-        slower than no pipelining at all on XLA:CPU."""
+        """Pipelined dispatch through the bucket's in-flight ring:
+        STAGE this batch's lanes (host packing + the tiny device
+        staging programs) while earlier programs execute, then — only
+        if the ring is FULL — wait for and displace the ring's oldest
+        batch, then dispatch this batch's program, then resolve the
+        displaced batch while this one executes.  Staging is the host
+        work that used to serialize with execution — overlapping it is
+        what breaks the host-bound serving ceiling (docs/PERF.md §11).
+
+        At depth 1 the ring is one service-wide slot, so every
+        dispatch displaces: the beat is exactly PR 6's stage -> wait
+        previous -> start -> resolve previous, and no two fleet
+        programs ever compute concurrently (on XLA:CPU concurrent
+        programs share the cores and fetches queue behind the new
+        program — measured slower than no pipelining at all).  At
+        depth >= 2 a dispatch into a ring with a free slot starts
+        IMMEDIATELY: independent buckets overlap on the device, and a
+        bucket's own dispatches stack ``pipeline_depth`` deep before
+        the oldest is waited on — the concurrency is the point on
+        hardware where host and device do not share silicon."""
         now = self.clock()
         reqs = self._drop_expired(reqs, now)
         if not reqs:
@@ -989,15 +1087,25 @@ class FleetService:
             return
         for r in reqs:
             self._handles[r.rid]._launched = True
-        prev, self._inflight = self._inflight, _Inflight(
-            key=key, reqs=reqs, pending=pending, width=width, idx=idx,
-            fault=fault, builds=builds, t_q0=t_q0)
-        # the pipeline beat, in order: (1) wait for the previous
-        # batch's program to finish WITHOUT fetching, (2) dispatch
-        # this batch's program onto the now-idle devices, (3) fetch +
-        # complete the previous batch while this one executes.  Two
-        # programs never compute concurrently (they would just share
-        # the cores), and the device never idles on host work.
+        infl = _Inflight(key=key, reqs=reqs, pending=pending,
+                         width=width, idx=idx, fault=fault,
+                         builds=builds, t_q0=t_q0)
+        rkey = self._ring_key(key)
+        ring = self._rings.setdefault(rkey, deque())
+        # the ring beat, in order: (1) if this batch's ring is full,
+        # wait for its OLDEST batch's program to finish WITHOUT
+        # fetching (a ring stall — the only point the pipeline ever
+        # blocks on the device), (2) dispatch this batch's program,
+        # (3) fetch + complete the displaced batch while this one
+        # executes.  A ring with a free slot skips (1) and (3)
+        # entirely: the program starts with zero waiting and
+        # resolution is deferred to a later displacement, harvest, or
+        # flush.
+        prev: Optional[_Inflight] = None
+        if len(ring) >= self.pipeline_depth:
+            prev = ring.popleft()
+            self._ring_stalls += 1
+        ring.append(infl)
         if prev is not None:
             try:
                 prev.pending.wait()
@@ -1011,7 +1119,9 @@ class FleetService:
         try:
             pending.start()
         except Exception as e:
-            self._inflight = None
+            ring.pop()           # infl is the newest slot
+            if not ring:
+                self._rings.pop(rkey, None)
             start_err = e
         except BaseException:
             if prev is not None:
@@ -1675,6 +1785,17 @@ class FleetService:
             "pending": self.pending,
             "in_flight": self.in_flight,
             "pipeline": self.pipeline,
+            # the ring plane (PR 17): configured depth, how deep each
+            # bucket's ring is stacked RIGHT NOW (reqs per in-flight
+            # batch, oldest first — empty dict when nothing is in
+            # flight), and how often a dispatch found its ring full
+            # and had to wait on (displace) the oldest slot.  Like
+            # ``in_flight``, a read-only view: stats() never resolves.
+            "pipeline_depth": self.pipeline_depth,
+            "in_flight_by_bucket": {
+                repr(k): [len(i.reqs) for i in ring]
+                for k, ring in self._rings.items() if ring},
+            "ring_stalls": self._ring_stalls,
             "dispatches": self._dispatch_count,
             "mean_occupancy": round(float(occ.mean()), 4) if occ.size else 0.0,
             "latency_p50_s": round(float(np.percentile(lat, 50)), 6)

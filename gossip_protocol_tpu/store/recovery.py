@@ -42,6 +42,7 @@ from .spill import CheckpointValidationError
 #: FleetService (everything else is either wall-clock policy or
 #: caller-supplied)
 _META_PARAMS = ("max_batch", "pad_policy", "pipeline",
+                "pipeline_depth",
                 "checkpoint_every", "checkpoint_every_s")
 
 

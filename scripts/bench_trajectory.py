@@ -12,9 +12,11 @@ graded + oracle pass rate, PR 9+), the durable-serving columns
 static-analysis columns (findings + rule-inventory size recorded by
 ``bench --check``, PR 14+; older jsons without an entry render "-"),
 and the compile-surface columns (exact vs canonical bucket
-cardinality, fresh-build collapse, warm-lap hit rate, PR 16+)
-— so a regression (or a claimed win) is visible at a glance, PR
-over PR.
+cardinality, fresh-build collapse, warm-lap hit rate, PR 16+),
+and the pipeline-depth columns (the best replay row's
+pipeline_depth plus the depth-sweep's measured open-loop saturation
+at depth 2, PR 17+) — so a regression (or a claimed win) is visible
+at a glance, PR over PR.
 
     PYTHONPATH=. python scripts/bench_trajectory.py          # table
     PYTHONPATH=. python scripts/bench_trajectory.py --json   # rows
@@ -41,7 +43,9 @@ def _get(d: dict, *path, default=None):
 
 def _best_replay(sec: dict):
     """Best recorded serving-replay row in one json: (speedup, p95,
-    device_wait_frac, requests, tag)."""
+    device_wait_frac, requests, tag, pipeline_depth).  Older jsons'
+    rows predate the pipeline_depth field (PR 17) — it rides as
+    None and renders "-"."""
     best = None
     for tag in ("service_replay_mixed", "service_replay_mixed_mesh",
                 "service_replay_pipeline_204req"):
@@ -60,7 +64,8 @@ def _best_replay(sec: dict):
             if sp is None:
                 continue
             row = (sp, r.get("latency_p95_s"),
-                   r.get("device_wait_frac"), r.get("requests"), tag)
+                   r.get("device_wait_frac"), r.get("requests"), tag,
+                   r.get("pipeline_depth"))
             if best is None or sp > best[0]:
                 best = row
     for tag in ("service_replay_mesh_curve_204req",):
@@ -71,7 +76,8 @@ def _best_replay(sec: dict):
                     sp = r.get("speedup_vs_sequential")
                     if sp is not None and (best is None or sp > best[0]):
                         best = (sp, r.get("latency_p95_s"),
-                                r.get("device_wait_frac"), 204, tag)
+                                r.get("device_wait_frac"), 204, tag,
+                                r.get("pipeline_depth"))
     return best
 
 
@@ -115,6 +121,13 @@ def load_rows():
         # canonicalization gate — exact vs canonical bucket
         # cardinality, fresh-build collapse, warm-lap hit rate
         surf = sec.get("compile_surface") or {}
+        # depth-sweep entry (PR 17+): the per-bucket in-flight ring
+        # ladder under service_load_openloop — one row per
+        # pipeline_depth with the measured open-loop saturation; the
+        # headline is the depth-2 shift vs depth-1
+        ds_rows = _get(load, "depth_sweep", "rows") or []
+        ds_sat = {r.get("depth"): r.get("saturation_offered_rps")
+                  for r in ds_rows if isinstance(r, dict)}
         rows.append({
             "pr": pr,
             "backend": d.get("backend"),
@@ -126,6 +139,7 @@ def load_rows():
             "replay_p95_s": replay[1] if replay else None,
             "replay_device_wait_frac": replay[2] if replay else None,
             "replay_source": replay[4] if replay else None,
+            "replay_pipeline_depth": replay[5] if replay else None,
             "chaos_completion": chaos.get("completion_rate"),
             "chaos_speedup": chaos.get("speedup_vs_sequential"),
             "elastic_completion": elastic.get("completion_rate"),
@@ -136,6 +150,12 @@ def load_rows():
             "load_miss_rate_slo_on": load_miss,
             "load_deterministic": _get(load, "replay_check",
                                        "deterministic"),
+            "depth_sweep_depths": ("/".join(
+                str(r["depth"]) for r in ds_rows
+                if isinstance(r, dict) and "depth" in r)
+                or None),
+            "depth1_saturation_rps": ds_sat.get(1),
+            "depth2_saturation_rps": ds_sat.get(2),
             "scenario_variants": scen.get("variants"),
             "scenario_families": scen.get("families"),
             "scenario_worlds": scen.get("worlds"),
@@ -189,6 +209,8 @@ def main(argv) -> int:
             ("legs", "elastic_mean_legs", "{:.1f}"),
             ("load rps", "load_max_achieved_rps", "{:.1f}"),
             ("sat rps", "load_saturation_rps", "{:.1f}"),
+            ("depth", "replay_pipeline_depth", "{}"),
+            ("d2 sat", "depth2_saturation_rps", "{:.1f}"),
             ("scen", "scenario_variants", "{}"),
             ("worlds", "scenario_worlds", "{}"),
             ("scen ok", "scenario_pass_rate", "{:.0%}"),

@@ -268,6 +268,42 @@ def main(argv) -> int:
               f"pipelined speedup {speedup:.2f}x "
               f"{'OK' if speedup > 5.62 else 'FAIL'} (>5.62x), "
               f"parity OK (enforced)", flush=True)
+        # ---- the ring-depth sweep (PR 17) ----------------------------
+        # closed-loop replay at depth 1/2/4 (single device, shared
+        # sequential baseline — depth changes resolution order, not
+        # results: parity is enforced per row), then the open-loop
+        # ladder at the same depths (loadbench.depth_ladder: one
+        # capacity anchor, identical schedules per point).  The PR 17
+        # gate: depth 2 must hold off open-loop saturation at least as
+        # long as depth 1.
+        from gossip_protocol_tpu.service.loadbench import (
+            default_slo, depth_ladder, effective_saturation,
+            load_catalog)
+        print("\npipeline_depth sweep (single device, closed-loop "
+              "replay):", flush=True)
+        for depth in (1, 2, 4):
+            m = replay(tpls, seeds, sequential=seq, max_batch=8,
+                       pipeline_depth=depth)
+            print(f"depth={depth}: {m['speedup_vs_sequential']:5.2f}x "
+                  f"sequential, ring stalls {m['ring_stalls']}, "
+                  f"p95 {m['latency_p95_s']:.2f}s", flush=True)
+        ladder = depth_ladder(load_catalog(n=256, ticks=48),
+                              n_probe=16, n_point=24, seed=20260807,
+                              slo=default_slo(),
+                              fracs=(0.5, 1.0, 1.5, 2.0))
+        sat = {}
+        for row in ladder["rows"]:
+            sat[row["depth"]] = effective_saturation(row)
+            s = row["saturation_offered_rps"]
+            print(f"depth={row['depth']}: open-loop saturation "
+                  f"{'none (absorbed all)' if s is None else f'{s} rps'}"
+                  f", max achieved {row['max_achieved_rps']} rps, "
+                  f"closed-loop {row['closed_loop_rps']} rps",
+                  flush=True)
+        depth_ok = sat.get(2, 0.0) >= sat.get(1, 0.0)
+        print(f"acceptance (depth sweep): depth-2 saturation >= "
+              f"depth-1 {'OK' if depth_ok else 'FAIL'}", flush=True)
+        ok = ok and depth_ok
         return 0 if ok else 1
     elif mode == "chaos":
         from gossip_protocol_tpu.parallel.fleet_mesh import make_lane_mesh

@@ -524,6 +524,51 @@ def good_draw(seed, idx, now=time.perf_counter):
     assert not any("good_draw" in f.path for f in findings)
 
 
+def test_wall_clock_in_ring_harvest_order_is_caught():
+    """The PR 17 ring coverage: the no-wall-clock rule SCOPED to the
+    harvest-ordering functions (purity_lint.RING_ORDER_FUNCS form)
+    fires on a ``time.*`` call or an RNG tiebreak inside them — the
+    exact bug class that would make in-flight resolution order (and
+    hence every chaos/elastic digest) depend on host timing — while
+    wall clock elsewhere in the same module stays out of scope."""
+    src = """
+import time
+import numpy as np
+
+class Svc:
+    def _harvest_ready(self):
+        # ordering by arrival wall time: the violation
+        heads = sorted(self._rings, key=lambda k: time.monotonic())
+        return heads
+
+    def _pop_oldest_inflight(self):
+        if np.random.random() < 0.5:        # RNG tiebreak: violation
+            return None
+        for rkey in list(self._rings):
+            return self._rings[rkey].popleft()
+
+    def _deadline_slack(self, req):
+        # wall clock OUTSIDE the harvest path: legitimately allowed
+        return req.deadline - time.monotonic()
+"""
+    scoped = ("_harvest_ready", "_pop_oldest_inflight")
+    findings = purity_lint.lint_source(
+        src, rule="no-wall-clock-in-pure-paths", pure_funcs=scoped)
+    assert len(findings) == 2, [str(f) for f in findings]
+    assert all(f.rule == "no-wall-clock-in-pure-paths"
+               for f in findings)
+    assert {f.where.split(":")[-1] for f in findings} == {"8", "12"}
+    # the deadline helper's time.monotonic() is NOT flagged: scoping
+    # is what lets the rule cover scheduler.py at all
+    assert not any("_deadline_slack" in (f.path or "")
+                   for f in findings)
+    # and the shipped scheduler's ring functions are covered + clean
+    rel = "gossip_protocol_tpu/service/scheduler.py"
+    assert rel in purity_lint.RING_ORDER_FUNCS
+    assert purity_lint.raw_findings(
+        "no-wall-clock-in-pure-paths", rel) == []
+
+
 def test_jnp_in_staging_function_is_caught():
     src = """
 import jax

@@ -432,12 +432,17 @@ def test_fault_in_batch_k_does_not_corrupt_staged_k_plus_1():
     """A poison fault detected while resolving batch k — AFTER batch
     k+1 (a different bucket) has already been staged and dispatched —
     must retry k in place without touching k+1: both buckets complete
-    with bit-parity, only k pays retries."""
+    with bit-parity, only k pays retries.  Pinned at
+    ``pipeline_depth=1`` — the PR 6 service-wide slot, where staging
+    k+1 is what displaces and resolves k (at depth >= 2 the buckets
+    ride independent rings and k resolves at flush instead;
+    test_resilience.py::test_chaos_digest_depth2_two_buckets covers
+    that plane)."""
     cfg_a = _dense_churn(n=16, ticks=22)
     cfg_b = _dense_churn(n=12, ticks=26)
     ref_a = Simulation(cfg_a).run(seed=1)
     ref_b = Simulation(cfg_b).run(seed=3)
-    svc = FleetService(max_batch=2, pipeline=True,
+    svc = FleetService(max_batch=2, pipeline=True, pipeline_depth=1,
                        injector=FaultInjector(schedule={1: "poison"}),
                        retry=_fast_retry())
     ha = [svc.submit(cfg_a, seed=s) for s in (1, 2)]   # batch k
@@ -455,6 +460,113 @@ def test_fault_in_batch_k_does_not_corrupt_staged_k_plus_1():
     assert np.array_equal(hb[0].result().sent, ref_b.sent)
     st = svc.stats()["failures"]
     assert st["poisoned_lanes"] == 1 and st["retries"] == 1
+    assert not svc._handles
+
+
+def test_fault_isolation_depth2_two_buckets():
+    """PR 17: at depth 2 with TWO buckets riding independent rings, a
+    poison fault in bucket A's batch (caught at A's resolve) must not
+    corrupt bucket B's staged batch or shift B's attempt indices: A
+    pays the retry (a NEW attempt index drawn after both launches), B
+    resolves clean with retries == 0, and both buckets return
+    bit-parity results."""
+    cfg_a = _dense_churn(n=16, ticks=22)
+    cfg_b = _dense_churn(n=12, ticks=26)
+    ref_a = Simulation(cfg_a).run(seed=1)
+    ref_b = Simulation(cfg_b).run(seed=3)
+    svc = FleetService(max_batch=2, pipeline=True, pipeline_depth=2,
+                       injector=FaultInjector(schedule={1: "poison"}),
+                       retry=_fast_retry())
+    ha = [svc.submit(cfg_a, seed=s) for s in (1, 2)]   # attempt 1
+    hb = [svc.submit(cfg_b, seed=s) for s in (3, 4)]   # attempt 2
+    # independent rings: BOTH batches are in flight — staging B did
+    # not displace (or resolve, or poison-retry) A
+    assert svc.in_flight == 4
+    assert [h.status for h in ha + hb] == ["in_flight"] * 4
+    st = svc.stats()
+    assert st["pipeline_depth"] == 2
+    assert len(st["in_flight_by_bucket"]) == 2
+    svc.drain()
+    # A's poison surfaced at its own resolve and retried there
+    # (attempt 3); B's attempt index was drawn before the fault ever
+    # surfaced, so its schedule position — and results — are untouched
+    assert [h.status for h in ha] == ["completed", "completed"]
+    assert all(h.metrics.retries == 1 for h in ha)
+    assert [h.status for h in hb] == ["completed", "completed"]
+    assert all(h.metrics.retries == 0 for h in hb)
+    assert svc._attempts == 3
+    assert np.array_equal(ha[0].result().sent, ref_a.sent)
+    assert np.array_equal(hb[0].result().sent, ref_b.sent)
+    fs = svc.stats()["failures"]
+    assert fs["poisoned_lanes"] == 1 and fs["retries"] == 1
+    assert not svc._handles
+
+
+def test_chaos_digest_depth2_two_buckets():
+    """PR 17: the chaos digest gate pinned at depth 2 with two active
+    bucket shapes — the seeded fault schedule and per-request outcomes
+    stay a pure function of the submit/flush sequence when independent
+    buckets overlap in flight."""
+    tpls = (overlay_templates(n=128, ticks=48)
+            + overlay_templates(n=64, ticks=48))
+    kw = dict(seeds_per_template=3, max_batch=4, fault_seed=11,
+              fault_rate=0.3, device_loss_at=None, pipeline=True,
+              pipeline_depth=2)
+    m1, seq = chaos_replay(tpls, return_legs=True, **kw)
+    m2 = chaos_replay(tpls, sequential=seq, **kw)
+    assert m1["pipeline"] is True and m1["pipeline_depth"] == 2
+    assert m1["faults"]["total"] > 0
+    assert m1["schedule_digest"] == m2["schedule_digest"]
+    assert m1["outcome_digest"] == m2["outcome_digest"]
+    assert m1["completion_rate"] == m2["completion_rate"] == 1.0
+
+
+def test_interrupted_flush_requeues_exactly_once_ring():
+    """PR 17: the interrupted-flush contract generalized to the
+    rings — with TWO buckets' batches in flight at depth 2, a
+    non-Exception escape out of a third dispatch re-queues every
+    unresolved request EXACTLY once (the popped batch via the
+    backstop, both in-flight batches via the ring abort), and the
+    next drain serves all of them with parity."""
+    from gossip_protocol_tpu.service import bucket_key
+    cfg_a = _dense_churn(n=16, ticks=22)
+    cfg_b = _dense_churn(n=12, ticks=26)
+    ref_a = Simulation(cfg_a).run(seed=1)
+    ref_b = Simulation(cfg_b).run(seed=5)
+    # pump_harvest=False: idle pumps between the submits must not
+    # harvest batch A before the interrupt lands — the test needs both
+    # rings occupied at the escape point
+    svc = FleetService(max_batch=2, pipeline=True, pipeline_depth=2,
+                       pump_harvest=False)
+    key_a = bucket_key(cfg_a, "trace")
+    ha = [svc.submit(cfg_a, seed=s) for s in (1, 2)]
+    hb = [svc.submit(cfg_b, seed=s) for s in (5, 6)]
+    assert svc.in_flight == 4
+    sim = svc.cache.get(key_a, cfg_a)
+    real_launch = sim.launch
+    boom = {"armed": True}
+
+    def interrupted_launch(*a, **kw):
+        if boom.pop("armed", False):
+            raise KeyboardInterrupt
+        return real_launch(*a, **kw)
+
+    sim.launch = interrupted_launch
+    h3 = svc.submit(cfg_a, seed=3)
+    with pytest.raises(KeyboardInterrupt):
+        svc.submit(cfg_a, seed=4)      # fills bucket A -> dispatches
+    # everything is back in its queue, exactly once, in rid order
+    assert svc.in_flight == 0
+    qa = svc._queues[key_a]
+    qb = svc._queues[bucket_key(cfg_b, "trace")]
+    assert len(qa) == 4 and len({r.rid for r in qa}) == 4
+    assert [r.rid for r in qa] == sorted(r.rid for r in qa)
+    assert len(qb) == 2 and len({r.rid for r in qb}) == 2
+    assert all(h.status == "pending" for h in ha + hb + [h3])
+    svc.drain()
+    assert all(h.status == "completed" for h in ha + hb + [h3])
+    assert np.array_equal(ha[0].result().sent, ref_a.sent)
+    assert np.array_equal(hb[0].result().sent, ref_b.sent)
     assert not svc._handles
 
 

@@ -358,7 +358,8 @@ def test_pump_harvest_false_pins_idle_harvest_off():
     assert svc.in_flight == 2
     deadline = _time.perf_counter() + 2.0
     while _time.perf_counter() < deadline and \
-            not svc._inflight.pending.is_ready():
+            not all(i.pending.is_ready()
+                    for i in svc._inflight_batches()):
         _time.sleep(0.01)
     assert svc.pump() == 0
     assert svc.in_flight == 2, "idle pump harvested with harvest off"
