@@ -461,6 +461,147 @@ def _overlay_entry(res, backend: str) -> dict:
     return _entry(res.cfg, res.node_ticks_per_second, backend)
 
 
+def _sv_entry(sv: dict) -> dict:
+    """Serving-replay entry schema (shared by the mixed / mesh /
+    mesh2d rows — one definition, so the rows cannot drift apart)."""
+    return {
+        "requests": sv["requests"],
+        "devices": sv["devices"],
+        # the PR-19 mesh decomposition (1-D meshes report
+        # lanes == devices, peers == 1; absent in pre-PR-19
+        # jsons; the trajectory renders "-")
+        "lanes": sv["lanes"],
+        "peers": sv["peers"],
+        "pipeline": sv["pipeline"],
+        # the PR-17 ring plane: configured in-flight depth and
+        # how often a dispatch found its ring full (absent in
+        # pre-PR-17 jsons; the trajectory renders "-")
+        "pipeline_depth": sv["pipeline_depth"],
+        "ring_stalls": sv["ring_stalls"],
+        "speedup_vs_sequential": sv["speedup_vs_sequential"],
+        "aggregate_node_ticks_per_s":
+            sv["aggregate_node_ticks_per_s"],
+        "latency_p50_s": sv["latency_p50_s"],
+        "latency_p95_s": sv["latency_p95_s"],
+        "mean_occupancy": sv["mean_occupancy"],
+        # the PR-6 wall decomposition: pack / execute / fetch
+        "mean_pack_s": sv["mean_pack_s"],
+        "mean_device_wait_s": sv["mean_device_wait_s"],
+        "mean_fetch_s": sv["mean_fetch_s"],
+        "device_wait_frac": sv["device_wait_frac"],
+        "cache_hit_rate": sv["cache_hit_rate"],
+        "buckets": sv["buckets"],
+        "max_builds_per_bucket": sv["max_builds_per_bucket"],
+    }
+
+
+def _mesh2d_entry(smoke: bool) -> dict:
+    """2-D lanes x peers serving (PR 19, docs/SERVING.md "2-D
+    capacity"): the acceptance stream PLUS a peer-SHARDABLE dense tier
+    (n=16 divides both the 4- and 2-wide peer rungs; the grader's N=10
+    and the overlay family stay peer-replicated, so the mixed stream
+    proves both routings serve side by side) over the lanes x peers
+    factorizations of 8 devices at equal total lane width.  replay()
+    enforces per-request bit-parity on every row; the elastic leg
+    serves the same stream from the (2,4) mesh with one seeded device
+    loss + return, and elastic_replay raises unless the shrink drops a
+    PEER shard (zero restarted lanes), checkpointed lanes migrate, and
+    the grow restores the full (2,4) decomposition — the rows existing
+    IS the gate."""
+    import jax
+    if jax.device_count() < 8:
+        raise RuntimeError(
+            f"mesh2d bench needs 8 (virtual) devices; only "
+            f"{jax.device_count()} live — force "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+    from gossip_protocol_tpu.config import SimConfig
+    from gossip_protocol_tpu.parallel.fleet_mesh import \
+        make_lane_peer_mesh
+    from gossip_protocol_tpu.service import (Template, elastic_replay,
+                                             grader_templates,
+                                             overlay_templates)
+    from gossip_protocol_tpu.service import replay as service_replay
+    n_sv, t_sv, seeds_sv = (256, 48, 2) if smoke else (512, 96, 8)
+    sv_lanes = min(8, 2 * seeds_sv)
+    tpls2 = (grader_templates()
+             + overlay_templates(n=n_sv, ticks=t_sv)
+             + [Template("dense16-drop", SimConfig(
+                 max_nnb=16, single_failure=False, drop_msg=True,
+                 msg_drop_prob=0.1, seed=0, total_ticks=60,
+                 fail_tick=30, rejoin_after=15, drop_open_tick=10,
+                 drop_close_tick=50))])
+    seq2 = None
+    sweep2 = {}
+    for lanes2, peers2 in ((2, 4), (4, 2)):
+        kw2 = dict(seeds_per_template=seeds_sv,
+                   max_batch=sv_lanes // lanes2,
+                   mesh=make_lane_peer_mesh(lanes2, peers2))
+        if seq2 is None:
+            sv2, seq2 = service_replay(tpls2, return_legs=True, **kw2)
+        else:
+            sv2 = service_replay(tpls2, sequential=seq2, **kw2)
+        sweep2[f"{lanes2}x{peers2}"] = _sv_entry(sv2)
+    # smoke's 48-tick overlay tier is ONE segment at a 48-tick
+    # budget — halve it so every bucket has a resumable leg for the
+    # loss/return events to land on
+    el2 = elastic_replay(tpls2, seeds_per_template=seeds_sv,
+                         max_batch=sv_lanes // 2,
+                         mesh=make_lane_peer_mesh(2, 4),
+                         checkpoint_every=32 if smoke else 48,
+                         fault_seed=20260807, sequential=seq2)
+    return {
+        "sweep": sweep2,
+        "elastic_2x4": {
+            "fault_seed": el2["fault_seed"],
+            "checkpoint_every": el2["checkpoint_every"],
+            "device_loss_at": el2["device_loss_at"],
+            "device_return_at": el2["device_return_at"],
+            "requests": el2["requests"],
+            "completion_rate": el2["completion_rate"],
+            "restarted_from_zero": el2["restarted_from_zero"],
+            "elastic": el2["elastic"],
+            "mean_legs": el2["mean_legs"],
+            "cache_rekey_hits": el2["cache_rekey_hits"],
+            "devices_start": el2["devices_start"],
+            "devices_end": el2["devices_end"],
+            "lanes_end": el2["lanes_end"],
+            "peers_end": el2["peers_end"],
+            "speedup_vs_sequential": el2["speedup_vs_sequential"],
+            "schedule_digest": el2["schedule_digest"],
+            "outcome_digest": el2["outcome_digest"],
+            "parity_checked": el2["parity_checked"],
+        },
+        "env": _env_provenance(),
+    }
+
+
+def _mesh2d_subprocess(smoke: bool) -> dict:
+    """Measure the mesh2d entry in a CHILD process with 8 forced
+    virtual devices.  The parent's headline must be measured on the
+    unsplit host — forcing virtual devices partitions the XLA host
+    threadpool and roughly halves the single-program rate — so the
+    2-D row records its OWN env provenance (the child's forced flags)
+    instead of inheriting the parent's.  A child failure propagates:
+    every in-line serving gate (parity, zero restarts, grow-back)
+    still fails the bench run."""
+    import subprocess
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    cmd = [sys.executable, os.path.abspath(__file__), "--mesh2d-sub"]
+    if smoke:
+        cmd.append("--smoke")
+    p = subprocess.run(cmd, env=env, capture_output=True, text=True)
+    if p.returncode != 0:
+        raise RuntimeError(
+            f"mesh2d bench subprocess failed (rc={p.returncode}): "
+            f"{p.stderr[-800:]}")
+    return json.loads(p.stdout.strip().splitlines()[-1])
+
+
 def main():
     smoke = "--smoke" in sys.argv
     backend = _backend_or_cpu(60.0 if smoke else 180.0)
@@ -519,32 +660,6 @@ def main():
         from gossip_protocol_tpu.service import (grader_templates,
                                                  overlay_templates)
         from gossip_protocol_tpu.service import replay as service_replay
-
-        def _sv_entry(sv: dict) -> dict:
-            return {
-                "requests": sv["requests"],
-                "devices": sv["devices"],
-                "pipeline": sv["pipeline"],
-                # the PR-17 ring plane: configured in-flight depth and
-                # how often a dispatch found its ring full (absent in
-                # pre-PR-17 jsons; the trajectory renders "-")
-                "pipeline_depth": sv["pipeline_depth"],
-                "ring_stalls": sv["ring_stalls"],
-                "speedup_vs_sequential": sv["speedup_vs_sequential"],
-                "aggregate_node_ticks_per_s":
-                    sv["aggregate_node_ticks_per_s"],
-                "latency_p50_s": sv["latency_p50_s"],
-                "latency_p95_s": sv["latency_p95_s"],
-                "mean_occupancy": sv["mean_occupancy"],
-                # the PR-6 wall decomposition: pack / execute / fetch
-                "mean_pack_s": sv["mean_pack_s"],
-                "mean_device_wait_s": sv["mean_device_wait_s"],
-                "mean_fetch_s": sv["mean_fetch_s"],
-                "device_wait_frac": sv["device_wait_frac"],
-                "cache_hit_rate": sv["cache_hit_rate"],
-                "buckets": sv["buckets"],
-                "max_builds_per_bucket": sv["max_builds_per_bucket"],
-            }
 
         n_sv, t_sv, seeds_sv = (256, 48, 2) if smoke else (512, 96, 8)
         sv_templates = grader_templates() + overlay_templates(n=n_sv,
@@ -622,9 +737,13 @@ def main():
             from gossip_protocol_tpu.parallel.fleet_mesh import \
                 make_lane_mesh as _mk_mesh_el
             el_mesh = _mk_mesh_el(el_d)
+        # smoke's 48-tick overlay tier is ONE segment at a 48-tick
+        # budget — halve it so every bucket has a resumable leg for
+        # the loss/return events to land on (only reachable with a
+        # live mesh, i.e. forced virtual devices)
         el = elastic_replay(sv_templates, seeds_per_template=seeds_sv,
                             max_batch=sv_lanes // el_d, mesh=el_mesh,
-                            checkpoint_every=48,
+                            checkpoint_every=32 if smoke else 48,
                             fault_seed=20260804, sequential=seq_leg)
         secondary["service_replay_elastic"] = {
             "fault_seed": el["fault_seed"],
@@ -701,6 +820,19 @@ def main():
                                       mesh=make_lane_mesh(d),
                                       sequential=seq_leg)
                 secondary["service_replay_mixed_mesh"] = _sv_entry(sv_m)
+
+        # 2-D lanes x peers serving (PR 19, docs/SERVING.md "2-D
+        # capacity"): measured in THIS process when 8 (virtual)
+        # devices are already live, else in a child process with 8
+        # forced virtual devices (_mesh2d_subprocess — the headline
+        # above must stay on the unsplit host threadpool); either way
+        # the entry carries the env that produced it.
+        if sv_lanes % 4 == 0:
+            if jax.device_count() >= 8:
+                secondary["service_replay_mesh2d"] = _mesh2d_entry(smoke)
+            else:
+                secondary["service_replay_mesh2d"] = \
+                    _mesh2d_subprocess(smoke)
 
         # open-loop traffic plane (PR 7, docs/SERVING.md "Open-loop
         # traffic & SLOs"): seeded Poisson arrivals wall-paced through
@@ -995,4 +1127,9 @@ def check_static_analysis(summary: dict) -> int:
 
 
 if __name__ == "__main__":
+    if "--mesh2d-sub" in sys.argv:
+        # child mode for _mesh2d_subprocess: emit the mesh2d entry as
+        # the last stdout line (jax warnings may precede it)
+        print(json.dumps(_mesh2d_entry("--smoke" in sys.argv)))
+        sys.exit(0)
     main()

@@ -478,6 +478,36 @@ def build_programs(mesh_devices: int = 2) -> list[AuditedProgram]:
                   "CPU devices (the ROADMAP 2-D prototype; "
                   "bit-identical to the 1-D fleet — "
                   "tests/test_fleet_mesh.py)"))
+
+        # the PRODUCTION 2-D serving program (PR 19): the same
+        # composition built by MeshFleetSimulation itself — what
+        # FleetService(mesh=Mesh((lanes, peers))) actually dispatches
+        # for a peer-divisible dense bucket.  Held to the identical
+        # per-axis contract as the prototype registration above: the
+        # lane axis moves zero bytes, the peer axis stays within its
+        # 5-collective tick budget, and the replicated plane is
+        # exactly the unbatched set.
+        from ..parallel.fleet_mesh import MeshFleetSimulation as _MFS
+        ms2 = _MFS(dcfg, mesh2)
+        srun = ms2._dense_bench_fn(2, dcfg.n, True)
+        sjx = jax.make_jaxpr(srun.jitted)(*dargs)
+        slow = srun.jitted.lower(*dargs)
+        progs.append(AuditedProgram(
+            name="mesh2d-serving",
+            provenance=_provenance(_MFS._dense_bench_fn),
+            jaxpr=sjx, min_cond=1, lowered=slow,
+            contract=ShardingContract(
+                mesh_axes=("lanes", PEER_AXIS),
+                zero_collective_axes=("lanes",),
+                budgets={PEER_AXIS: LANE_PEER_TICK_COLLECTIVE_BUDGET},
+                replicated_plane=tuple(n for n, d in pdims if not d),
+                expected_in_names=pdims),
+            rules=("cond-stays-cond", "donation-taken",
+                   "no-transfer-in-scan"),
+            notes=f"the production serving path ({n2_lanes} lanes x "
+                  f"{n2_peers} peers, n={dcfg.n} peer-sharded): "
+                  "MeshFleetSimulation._dense_bench_fn with _peer_comm "
+                  "— FleetService(mesh=) dispatches this program"))
     else:
         progs.append(AuditedProgram(
             name=f"mesh2d-(skipped: {_jax.device_count()} device(s) "

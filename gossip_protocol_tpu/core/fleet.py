@@ -1834,6 +1834,13 @@ class CanonicalFleetSimulation(FleetSimulation):
     (canonical_supported routes them away before construction).
     """
 
+    #: pad-ladder rung multiple (a power of two): the mesh-canonical
+    #: subclass (parallel/fleet_mesh.py CanonicalMeshFleetSimulation)
+    #: pins its full-strength peer-shard count here before chaining
+    #: into this __init__, so rungs — and the canonical keys built
+    #: from them — stay peer-shard-divisible
+    _rung_multiple = 1
+
     def __init__(self, cfg: SimConfig, block_size: int = 128,
                  chunk_ticks: Optional[int] = None):
         from ..service.canonical import (canonical_bucket_key,
@@ -1844,8 +1851,9 @@ class CanonicalFleetSimulation(FleetSimulation):
                 f"config (model={cfg.model!r}) is not canonicalizable; "
                 "use FleetSimulation with the exact bucket key")
         self.member_cfg = cfg
-        self.rung = ladder_rung(cfg.n)
-        self._canon_key = canonical_bucket_key(cfg, "trace")
+        self.rung = ladder_rung(cfg.n, multiple=self._rung_multiple)
+        self._canon_key = canonical_bucket_key(
+            cfg, "trace", peers=self._rung_multiple)
         # the class's drop-stream width: real n for drop-on classes
         # (stream bit-identity pins it), None otherwise — mirrors the
         # stream_n component of canonical_fleet_shape_key
@@ -1867,7 +1875,8 @@ class CanonicalFleetSimulation(FleetSimulation):
         if not configs:
             raise ValueError("empty fleet")
         for i, c in enumerate(configs):
-            k = canonical_bucket_key(c, "trace")
+            k = canonical_bucket_key(c, "trace",
+                                     peers=self._rung_multiple)
             if k != self._canon_key:
                 raise ValueError(
                     f"lane {i} is not a member of this canonical "
@@ -2035,13 +2044,21 @@ class CanonicalFleetSimulation(FleetSimulation):
             "bakes the active-corner width and keeps exact buckets")
 
     def run_leg(self, *a, **kw):
-        raise NotImplementedError(
-            "canonical buckets serve monolithic traces only; "
-            "checkpoint legs validate exact-plan cuts and keep "
-            "exact buckets")
+        from ..service.canonical import CanonicalLegUnsupported
+        raise CanonicalLegUnsupported(
+            "canonical buckets serve monolithic traces only: "
+            "checkpoint legs validate resume cuts against the EXACT "
+            "segment plan, which canonical buckets quantize away — "
+            "serve legged work from exact buckets "
+            "(FleetService(canonicalize=False)); "
+            "docs/SERVING.md 'Bucket canonicalization'")
 
     def launch_leg(self, *a, **kw):
-        raise NotImplementedError(
-            "canonical buckets serve monolithic traces only; "
-            "checkpoint legs validate exact-plan cuts and keep "
-            "exact buckets")
+        from ..service.canonical import CanonicalLegUnsupported
+        raise CanonicalLegUnsupported(
+            "canonical buckets serve monolithic traces only: "
+            "checkpoint legs validate resume cuts against the EXACT "
+            "segment plan, which canonical buckets quantize away — "
+            "serve legged work from exact buckets "
+            "(FleetService(canonicalize=False)); "
+            "docs/SERVING.md 'Bucket canonicalization'")

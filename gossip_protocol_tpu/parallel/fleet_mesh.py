@@ -48,16 +48,25 @@ Compiled programs live in the process-wide ``_FLEET_FN_CACHE`` with
 the mesh descriptor in the key (core/fleet.py ``_mesh_entry``): a
 device-count change can never be served a stale program.
 
-On a TPU pod this lane mesh composes with §4's peer sharding as a
-2-D mesh (lanes × peers): the per-tick collectives stay *within* each
-lane's peer-axis submesh, and the lane axis still moves zero bytes.
-A working prototype of that composition ships below
-(:func:`make_lane_peer_mesh` / :func:`make_lane_peer_bench_fn`,
-validated bit-for-bit against the 1-D fleet on 8 virtual CPU devices
-and registered with the static analyzer as ``mesh2d-lanes-peers`` —
-analysis/sharding_flow.py gates its per-axis collective contract);
-the serving wiring and hardware validation remain PERF §10 /
-ROADMAP work.
+This lane mesh composes with §4's peer sharding as a 2-D mesh
+(lanes × peers): the per-tick collectives stay *within* each lane's
+peer-axis submesh, and the lane axis still moves zero bytes.  Since
+PR 19 the composition is the PRODUCTION path, not a prototype:
+:class:`MeshFleetSimulation` (and therefore ``FleetService(mesh=)``)
+accepts a 2-D ``Mesh((lanes, peers))`` directly — dense programs
+whose world width divides the peer axis run with the
+:class:`~.comm.RingComm` exchange inside the shard_mapped tick
+(``_peer_comm``); worlds that do not divide (and the overlay model,
+whose partial-view tick has no peer decomposition) serve with the
+peer axis REPLICATED, which is bit-identical by construction because
+every peer shard runs the same deterministic integer program.  The
+elastic ladder is axis-aware (:func:`shrink_mesh` halves the PEER
+axis of a 2-D mesh before it ever touches a lane; :func:`grow_mesh`
+steps it back up toward the captured full shape), and the standalone
+:func:`make_lane_peer_bench_fn` remains the analyzer's
+contract-carrying registration (``mesh2d-lanes-peers``,
+analysis/sharding_flow.py) alongside the production serving program
+(``mesh2d-serving``).  Hardware validation remains PERF §10 work.
 """
 
 from __future__ import annotations
@@ -72,7 +81,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..compat.jaxapi import shard_map
 from ..config import SimConfig
 from ..core.fleet import (EVENT_AXES, SCHED_AXES_BATCHED,
-                          SCHED_AXES_SHARED_DROP, WORLD_AXES,
+                          SCHED_AXES_CANON, SCHED_AXES_SHARED_DROP,
+                          WORLD_AXES, CanonicalFleetSimulation,
                           FleetSimulation)
 from ..core.tick import TickEvents, make_tick
 
@@ -96,14 +106,50 @@ def make_lane_mesh(n_devices: Optional[int] = None) -> Mesh:
 
 
 def mesh_descriptor(mesh: Mesh) -> tuple:
-    """Hashable identity of a lane mesh for program-cache keys."""
-    return (mesh.axis_names, tuple(d.id for d in mesh.devices.flat))
+    """Hashable identity of a serving mesh for program-cache keys.
+
+    Carries the device SHAPE as well as the flat ids: a 2×4 and a 4×2
+    mesh over the same eight devices compile different programs (the
+    peer decomposition differs), so their descriptors must differ too.
+    """
+    return (mesh.axis_names, tuple(d.id for d in mesh.devices.flat),
+            tuple(mesh.devices.shape))
+
+
+def mesh_axis_sizes(mesh: Optional[Mesh]) -> tuple:
+    """``(n_lanes, n_peers, peer_axis)`` of a serving mesh, validating
+    the accepted shapes: ``None`` (no mesh — one lane slot, no peer
+    axis), a 1-D lane mesh, or the 2-D ``Mesh((lanes, peers))``
+    composition.  Anything else — a transposed axis order, a 3-D
+    mesh, foreign axis names — is rejected here, once, so the service
+    and the fleet agree on what a mesh means."""
+    if mesh is None:
+        return 1, 1, None
+    names, shape = mesh.axis_names, tuple(mesh.devices.shape)
+    if mesh.devices.ndim == 1 and len(names) == 1:
+        return int(shape[0]), 1, None
+    from .sharded import PEER_AXIS
+    if mesh.devices.ndim == 2 and names == (LANE_AXIS, PEER_AXIS):
+        return int(shape[0]), int(shape[1]), PEER_AXIS
+    raise ValueError(
+        f"serving meshes are 1-D ({LANE_AXIS!r},) or 2-D "
+        f"({LANE_AXIS!r}, {PEER_AXIS!r}); got axes {names} "
+        f"shape {shape}")
 
 
 def shrink_mesh(mesh: Optional[Mesh]) -> Optional[Mesh]:
-    """One rung down the serving degradation ladder: the same lane
-    mesh minus its LAST device (``None`` once fewer than two remain —
-    the single-device fleet needs no mesh at all).
+    """One rung down the serving degradation ladder, axis-aware.
+
+    A 2-D lanes×peers mesh loses a PEER shard first: the peer axis is
+    HALVED (power-of-two peer counts keep every remaining width
+    peer-shard-divisible) over the flat device prefix, lanes
+    untouched; at one peer the mesh collapses to the 1-D lane mesh.
+    A 1-D mesh drops its LAST device (``None`` once fewer than two
+    remain — the single-device fleet needs no mesh at all).  Devices
+    are always kept as a PREFIX of the current flat order, so the
+    ladder's descriptors are a pure function of the rung — a
+    shrink→grow cycle re-keys back to descriptors that served before
+    (service/cache.py ``rebind_mesh``).
 
     This is the rebuild path the service takes on a (simulated or
     real) device loss: the shrunken mesh has a fresh
@@ -115,34 +161,69 @@ def shrink_mesh(mesh: Optional[Mesh]) -> Optional[Mesh]:
     """
     if mesh is None:
         return None
+    if mesh.devices.ndim == 2:
+        lanes, peers = mesh.devices.shape
+        new_peers = peers // 2
+        devs = list(mesh.devices.flat)[:lanes * max(1, new_peers)]
+        if new_peers <= 1:
+            if len(devs) < 2:
+                return None
+            return Mesh(np.array(devs), (LANE_AXIS,))
+        return Mesh(np.array(devs).reshape(lanes, new_peers),
+                    mesh.axis_names)
     devs = list(mesh.devices.flat)[:-1]
     if len(devs) < 2:
         return None
     return Mesh(np.array(devs), mesh.axis_names)
 
 
-def grow_mesh(mesh: Optional[Mesh], devices) -> Optional[Mesh]:
+def grow_mesh(mesh: Optional[Mesh], devices,
+              full_shape: Optional[tuple] = None,
+              full_axes: Optional[tuple] = None) -> Optional[Mesh]:
     """One rung UP the elasticity ladder — the inverse of
-    :func:`shrink_mesh`: the same lane mesh plus the next device of
-    ``devices``, the full-strength device tuple the service captured
-    at construction.
+    :func:`shrink_mesh`: the same mesh re-extended from ``devices``,
+    the full-strength device tuple the service captured at
+    construction.
 
-    :func:`shrink_mesh` always drops the LAST device, so a degraded
-    mesh's devices are a prefix of ``devices``; growing re-extends the
-    prefix one device at a time (``None`` — the single-device rung —
-    grows straight to a fresh 2-device mesh, mirroring shrink's
-    below-2 collapse).  The grown mesh has a fresh
-    :func:`mesh_descriptor`, so every mesh-keyed program cache misses
-    by construction — and when it re-keys back to a descriptor that
-    served before the loss, the service ProgramCache finds the
-    retained handles and programs again (service/cache.py
-    ``rebind_mesh`` re-keys rather than evicts).  Already at full
-    strength (or ``devices`` is None — the service never had a mesh):
-    returned unchanged.
+    :func:`shrink_mesh` always keeps a PREFIX of the flat device
+    order, so growing re-extends the prefix.  On the 1-D ladder (no
+    ``full_shape``, or a 1-D one) that is one device at a time
+    (``None`` — the single-device rung — grows straight to a fresh
+    2-device mesh, mirroring shrink's below-2 collapse).  With a 2-D
+    ``full_shape`` the lane axis is restored first, then the peer
+    axis DOUBLES back toward the full shape — the exact inverse of
+    the peer-halving shrink, so each grown descriptor equals the
+    descriptor the same rung had on the way down and the final grow
+    restores the original 2-D descriptor exactly.  The service
+    ProgramCache then finds the retained handles and programs again
+    (service/cache.py ``rebind_mesh`` re-keys rather than evicts).
+    Already at full strength (or ``devices`` is None — the service
+    never had a mesh): returned unchanged.
     """
     if devices is None:
         return mesh
     devs = list(devices)
+    if full_shape is not None and len(full_shape) == 2:
+        full_lanes, full_peers = (int(full_shape[0]), int(full_shape[1]))
+        if mesh is None:
+            cur_lanes, cur_peers = 0, 1
+        elif mesh.devices.ndim == 1:
+            cur_lanes, cur_peers = int(mesh.devices.size), 1
+        else:
+            cur_lanes, cur_peers = mesh.devices.shape
+        if cur_lanes < full_lanes:
+            nk = min(max(2, cur_lanes + 1), full_lanes, len(devs))
+            if nk <= cur_lanes:
+                return mesh
+            return Mesh(np.array(devs[:nk]), (LANE_AXIS,))
+        new_peers = min(max(2, cur_peers * 2), full_peers)
+        if new_peers <= cur_peers or full_lanes * new_peers > len(devs):
+            return mesh
+        if full_axes is None:
+            from .sharded import PEER_AXIS
+            full_axes = (LANE_AXIS, PEER_AXIS)
+        return Mesh(np.array(devs[:full_lanes * new_peers])
+                    .reshape(full_lanes, new_peers), tuple(full_axes))
     k = int(mesh.devices.size) if mesh is not None else 1
     nk = max(2, k + 1)
     if k >= len(devs) or nk > len(devs):
@@ -224,10 +305,12 @@ def make_lane_peer_bench_fn(cfg: SimConfig, mesh: Mesh,
     and the lane axis moves zero bytes — per-lane results are
     bit-identical to the 1-D lane fleet (tests/test_fleet_mesh.py runs
     the parity on 8 virtual CPU devices).  Returns the raw jitted
-    program ``(states, scheds) -> (states, (sent, recv))``; serving is
-    NOT wired through this path yet (ROADMAP), but the program is
-    registered with the static analyzer (``mesh2d-lanes-peers``) so
-    the per-axis collective rules gate the wiring PR before it lands.
+    program ``(states, scheds) -> (states, (sent, recv))``.  Since
+    PR 19 the same composition serves through
+    :class:`MeshFleetSimulation` (``_peer_comm``); this standalone
+    builder remains the analyzer's minimal contract-carrying
+    registration (``mesh2d-lanes-peers``) next to the production
+    serving program (``mesh2d-serving``).
     """
     from .comm import RingComm
     from .sharded import peer_spec_trees
@@ -283,14 +366,23 @@ def _place(tree, specs, mesh: Mesh):
 
 class MeshFleetSimulation(FleetSimulation):
     """:class:`~..core.fleet.FleetSimulation` with the lane axis
-    sharded over a 1-D device mesh.
+    sharded over a device mesh — 1-D (lanes) or 2-D (lanes × peers).
 
     Same API and same per-lane results (bit-identical) as the
-    single-device fleet; the batch must be a multiple of the mesh
-    size.  ``run``/``run_bench`` accept the same ``seeds=``/
+    single-device fleet; the batch must be a multiple of the LANE
+    axis size.  ``run``/``run_bench`` accept the same ``seeds=``/
     ``configs=``/``n_real=`` arguments — the serving layer drives
     this class through the unchanged scheduler with shard-divisible
     padding (service/scheduler.py ``mesh=``).
+
+    On a 2-D mesh, dense programs whose width divides the peer axis
+    run the :class:`~.comm.RingComm` exchange inside the
+    shard_mapped tick (each lane's collectives confined to its own
+    peer submesh — the composition :func:`make_lane_peer_bench_fn`
+    prototyped); everything else (non-divisible widths, the overlay
+    model) serves with the peer axis replicated — correct because
+    every peer shard runs the same deterministic integer program, at
+    the cost of redundant peer-axis compute for those buckets.
     """
 
     def __init__(self, cfg: SimConfig, mesh: Optional[Mesh] = None,
@@ -299,14 +391,23 @@ class MeshFleetSimulation(FleetSimulation):
         super().__init__(cfg, block_size=block_size,
                          chunk_ticks=chunk_ticks)
         self.mesh = mesh if mesh is not None else make_lane_mesh()
-        if self.mesh.devices.ndim != 1 or len(self.mesh.axis_names) != 1:
-            raise ValueError(
-                f"MeshFleetSimulation takes a 1-D lane mesh, got axes "
-                f"{self.mesh.axis_names} shape {self.mesh.devices.shape}")
+        self._n_lanes, self._n_peers, self._peer_axis = \
+            mesh_axis_sizes(self.mesh)
 
     @property
     def n_devices(self) -> int:
         return self.mesh.devices.size
+
+    @property
+    def n_lanes(self) -> int:
+        """Lane-axis width — the batch-divisibility unit (== device
+        count on a 1-D mesh)."""
+        return self._n_lanes
+
+    @property
+    def n_peers(self) -> int:
+        """Peer-axis width (1 on a 1-D mesh)."""
+        return self._n_peers
 
     # ---- program-cache identity -------------------------------------
     def _mesh_entry(self):
@@ -322,14 +423,35 @@ class MeshFleetSimulation(FleetSimulation):
     # ---- lane validation --------------------------------------------
     def _lane_cfgs(self, seeds, configs):
         cfgs = super()._lane_cfgs(seeds, configs)
-        d = self.n_devices
+        d = self.n_lanes
         if len(cfgs) % d:
             raise ValueError(
                 f"fleet of {len(cfgs)} lanes does not divide over the "
-                f"{d}-device {LANE_AXIS!r} mesh; pad to a multiple of "
+                f"{d}-wide {LANE_AXIS!r} axis; pad to a multiple of "
                 f"{d} (the serving layer's pad policies do this — "
                 "service/scheduler.py)")
         return cfgs
+
+    # ---- the peer axis -----------------------------------------------
+    def _peer_comm(self, n: int):
+        """The peer-axis exchange for an ``n``-peer world, or ``None``
+        when the program serves peer-replicated: no peer axis on the
+        mesh, or a width that does not divide it (the pad ladder under
+        ``canonicalize`` snaps widths to peer-divisible rungs; exact
+        buckets keep the member width and fall back to replication)."""
+        if self._peer_axis is None or n % self._n_peers:
+            return None
+        from .comm import RingComm
+        return RingComm(self._peer_axis, self._n_peers, use_pallas=False)
+
+    def _peer_specs(self, axes):
+        """``(state_specs, sched_specs)`` for one dense program with
+        the peer axis composed in (:func:`compose_lane_peer_specs`
+        over the peer-axis spec trees of parallel/sharded.py)."""
+        from .sharded import peer_spec_trees
+        peer_state, peer_sched = peer_spec_trees(self._peer_axis)
+        return (compose_lane_peer_specs(WORLD_AXES, peer_state),
+                compose_lane_peer_specs(axes, peer_sched))
 
     # ---- shared build plumbing --------------------------------------
     def _shard_run(self, body, state_specs, sched_specs, out_specs):
@@ -369,8 +491,9 @@ class MeshFleetSimulation(FleetSimulation):
     def _dense_bench_fn(self, batch: int, width: int, shared_drop: bool):
         def build():
             cfg_w = self.cfg.replace(max_nnb=width)
+            comm = self._peer_comm(cfg_w.n)
             tick = make_tick(cfg_w, self.block_size, use_pallas=False,
-                             with_events=False)
+                             with_events=False, comm=comm)
             axes = SCHED_AXES_SHARED_DROP if shared_drop \
                 else SCHED_AXES_BATCHED
             vtick = jax.vmap(tick, in_axes=(WORLD_AXES, axes),
@@ -383,11 +506,15 @@ class MeshFleetSimulation(FleetSimulation):
                     return carry, (ev.sent, ev.recv)
                 return jax.lax.scan(step, states, None, length=total)
 
-            state_specs = _axes_to_specs(WORLD_AXES)
-            # scan stacks ticks leading: (T, B, width) counters
-            cnt = P(None, LANE_AXIS)
-            return self._shard_run(body, state_specs,
-                                   _axes_to_specs(axes),
+            if comm is None:
+                state_specs = _axes_to_specs(WORLD_AXES)
+                sched_specs = _axes_to_specs(axes)
+                # scan stacks ticks leading: (T, B, width) counters
+                cnt = P(None, LANE_AXIS)
+            else:
+                state_specs, sched_specs = self._peer_specs(axes)
+                cnt = P(None, LANE_AXIS, self._peer_axis)
+            return self._shard_run(body, state_specs, sched_specs,
                                    (state_specs, (cnt, cnt)))
 
         return self._fleet_program(self._cache_key("bench", batch, width,
@@ -396,8 +523,9 @@ class MeshFleetSimulation(FleetSimulation):
     # ---- dense trace -------------------------------------------------
     def _dense_trace_fn(self, batch: int, length: int, shared_drop: bool):
         def build():
+            comm = self._peer_comm(self.cfg.n)
             tick = make_tick(self.cfg, self.block_size, use_pallas=False,
-                             with_events=True)
+                             with_events=True, comm=comm)
             axes = SCHED_AXES_SHARED_DROP if shared_drop \
                 else SCHED_AXES_BATCHED
             vtick = jax.vmap(tick, in_axes=(WORLD_AXES, axes),
@@ -408,11 +536,22 @@ class MeshFleetSimulation(FleetSimulation):
                     return vtick(carry, scheds)
                 return jax.lax.scan(step, states, None, length=length)
 
-            state_specs = _axes_to_specs(WORLD_AXES)
-            ev = P(None, LANE_AXIS)        # (T, B, ...) event stacks
-            ev_specs = TickEvents(added=ev, removed=ev, sent=ev, recv=ev)
-            return self._shard_run(body, state_specs,
-                                   _axes_to_specs(axes),
+            if comm is None:
+                state_specs = _axes_to_specs(WORLD_AXES)
+                sched_specs = _axes_to_specs(axes)
+                ev = P(None, LANE_AXIS)    # (T, B, ...) event stacks
+                ev_specs = TickEvents(added=ev, removed=ev,
+                                      sent=ev, recv=ev)
+            else:
+                state_specs, sched_specs = self._peer_specs(axes)
+                # events are row-sharded over the peer axis exactly as
+                # in parallel/sharded.py make_sharded_run: the (n, n)
+                # matrices on their row dim, the (n,) counters whole
+                em = P(None, LANE_AXIS, self._peer_axis, None)
+                ev = P(None, LANE_AXIS, self._peer_axis)
+                ev_specs = TickEvents(added=em, removed=em,
+                                      sent=ev, recv=ev)
+            return self._shard_run(body, state_specs, sched_specs,
                                    (state_specs, ev_specs))
 
         return self._fleet_program(self._cache_key("trace", batch, length,
@@ -459,3 +598,59 @@ class MeshFleetSimulation(FleetSimulation):
                                     _all_lane_specs(OverlayMetrics)))
 
         return self._fleet_program(self._cache_key("overlay", batch, length), build)
+
+
+class CanonicalMeshFleetSimulation(MeshFleetSimulation,
+                                   CanonicalFleetSimulation):
+    """A canonical equivalence class (core/fleet.py
+    :class:`~..core.fleet.CanonicalFleetSimulation`) served from a
+    device mesh: the rung-width canonical program shard_mapped over
+    the lane axis.
+
+    ``rung_multiple`` pins the pad-ladder to peer-shard-divisible
+    rungs (service/canonical.py ``ladder_rung(multiple=)``): on a 2-D
+    mesh the service passes its FULL-STRENGTH peer count, fixed for
+    the service's lifetime, so canonical bucket keys — and therefore
+    the class membership — never move when the elastic ladder halves
+    the peer axis (a rung divisible by the full power-of-two peer
+    count stays divisible by every halved one).  The canonical
+    program itself runs peer-REPLICATED: its rung re-shapes the world
+    (filler peer rows), and the drop stream's corner embedding is
+    defined on the whole table — replication keeps each peer shard
+    running the identical deterministic program, preserving the
+    bit-parity contract, while the snapped rung keeps the 2-D
+    descriptors consistent for a future peer-sharded rung program.
+
+    Like the base canonical class, monolithic trace dispatches only —
+    leg entrypoints raise the typed
+    :class:`~..service.canonical.CanonicalLegUnsupported` at lookup,
+    and the service refuses the combination at construction.
+    """
+
+    def __init__(self, cfg: SimConfig, mesh: Optional[Mesh] = None,
+                 block_size: int = 128,
+                 chunk_ticks: Optional[int] = None,
+                 rung_multiple: int = 1):
+        m = int(rung_multiple)
+        if m < 1 or m & (m - 1):
+            raise ValueError(
+                f"rung_multiple must be a power of two (the pad "
+                f"ladder doubles), got {rung_multiple}")
+        # read by CanonicalFleetSimulation.__init__ (reached through
+        # MeshFleetSimulation's super() chain) for the rung snap
+        self._rung_multiple = m
+        MeshFleetSimulation.__init__(self, cfg, mesh=mesh,
+                                     block_size=block_size,
+                                     chunk_ticks=chunk_ticks)
+
+    def _canon_trace_fn(self, batch: int, length: int):
+        def build():
+            body = self._canon_run_builder(length)
+            state_specs = _axes_to_specs(WORLD_AXES)
+            ev = P(None, LANE_AXIS)        # (T, B, ...) event stacks
+            ev_specs = TickEvents(added=ev, removed=ev, sent=ev, recv=ev)
+            return self._shard_run(body, state_specs,
+                                   _axes_to_specs(SCHED_AXES_CANON),
+                                   (state_specs, ev_specs))
+        return self._fleet_program(
+            self._cache_key("canon-trace", batch, length), build)

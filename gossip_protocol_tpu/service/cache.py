@@ -46,13 +46,20 @@ class ProgramCache:
 
     def __init__(self, block_size: int = 128,
                  chunk_ticks: Optional[int] = None, mesh=None,
-                 max_entries: Optional[int] = 64):
+                 max_entries: Optional[int] = 64,
+                 canon_rung_multiple: int = 1):
         if max_entries is not None and max_entries < 1:
             raise ValueError(f"max_entries must be >= 1 or None, "
                              f"got {max_entries}")
         self._block_size = block_size
         self._chunk_ticks = chunk_ticks
         self._mesh = mesh
+        # the pad-ladder snap for canonical handles: the service's
+        # FULL-STRENGTH peer count, fixed for the cache's lifetime so
+        # canonical keys (and class membership) survive elastic
+        # peer-shard shrink — rebind_mesh deliberately does NOT touch
+        # it (service/canonical.py ladder_rung)
+        self._canon_rung_multiple = int(canon_rung_multiple)
         self.max_entries = max_entries
         # entries are keyed (mesh descriptor, bucket key): rebinding
         # the mesh RE-KEYS the cache — handles (and their compiled
@@ -78,10 +85,12 @@ class ProgramCache:
                   canonical: bool = False) -> FleetSimulation:
         if canonical:
             if self._mesh is not None:
-                raise ValueError(
-                    "canonical buckets are single-device only (the "
-                    "mesh path shards the real peer axis; pad-ladder "
-                    "filler peers would change its decomposition)")
+                from ..parallel.fleet_mesh import \
+                    CanonicalMeshFleetSimulation
+                return CanonicalMeshFleetSimulation(
+                    cfg, self._mesh, block_size=self._block_size,
+                    chunk_ticks=self._chunk_ticks,
+                    rung_multiple=self._canon_rung_multiple)
             from ..core.fleet import CanonicalFleetSimulation
             return CanonicalFleetSimulation(
                 cfg, block_size=self._block_size,

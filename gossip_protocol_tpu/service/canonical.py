@@ -53,10 +53,41 @@ from .types import MODES
 LADDER_MIN = 4
 
 
-def ladder_rung(n: int) -> int:
-    """Next power-of-two rung >= max(n, LADDER_MIN)."""
+class CanonicalLegUnsupported(NotImplementedError, ValueError):
+    """Canonical buckets cannot serve checkpoint legs.
+
+    Legs validate resume cuts against the EXACT segment plan
+    (models/segments.py), which is precisely what canonical buckets
+    quantize away — a canonical leg would accept cuts its members'
+    exact plans reject.  Raised TYPED and EARLY: at ``FleetService``
+    construction when ``canonicalize=True`` meets
+    ``checkpoint_every``/``checkpoint_every_s``, and at the canonical
+    engine's own leg entrypoints (core/fleet.py
+    ``CanonicalFleetSimulation.run_leg``/``launch_leg``) for direct
+    engine users — never deep inside leg resolve.  Serve legged work
+    from exact buckets (``canonicalize=False``); docs/SERVING.md
+    'Bucket canonicalization' documents the trade.
+
+    Subclasses both ``NotImplementedError`` (the engine's historical
+    spelling for unserved canonical modes) and ``ValueError`` (the
+    service's constructor-gate spelling), so both matchers keep
+    working."""
+
+
+def ladder_rung(n: int, multiple: int = 1) -> int:
+    """Next power-of-two rung >= max(n, LADDER_MIN) that ``multiple``
+    divides.  ``multiple`` must itself be a power of two (the ladder
+    doubles, so any other multiple could never be reached): the mesh
+    serving path passes its peer-shard count, snapping every rung to
+    peer-shard-divisible widths so filler peer rows can never change
+    the peer-axis decomposition."""
+    m = int(multiple)
+    if m < 1 or m & (m - 1):
+        raise ValueError(
+            f"ladder_rung multiple must be a power of two (the pad "
+            f"ladder doubles), got {multiple}")
     r = LADDER_MIN
-    while r < n:
+    while r < n or r % m:
         r *= 2
     return r
 
@@ -68,7 +99,7 @@ def canonical_supported(cfg: SimConfig, mode: str) -> bool:
     return cfg.model != "overlay" and mode == "trace"
 
 
-def canonical_fleet_shape_key(cfg: SimConfig) -> tuple:
+def canonical_fleet_shape_key(cfg: SimConfig, peers: int = 1) -> tuple:
     """The pad-ladder twin of ``core/fleet.fleet_shape_key`` for dense
     configs: ``n`` quantizes to its ladder rung, and the worlds tail
     reduces to the static plane booleans the tick actually bakes.
@@ -80,8 +111,13 @@ def canonical_fleet_shape_key(cfg: SimConfig) -> tuple:
     draw stream — no cross-n collapse there, by bit-identity.  Drop-off
     configs never take the draw branch, so their rung programs are
     width-only and collapse across n freely.
+
+    ``peers`` (a power of two; the mesh serving path's FULL-STRENGTH
+    peer-shard count) snaps the rung to peer-shard-divisible widths —
+    the key carries the snapped rung, not ``peers`` itself, so peer
+    counts that land on the same rung still share a class.
     """
-    rung = ladder_rung(cfg.n)
+    rung = ladder_rung(cfg.n, multiple=peers)
     stream_n = cfg.n if (cfg.drop_msg or cfg.asym_drop) else None
     return ("canon_full_view", rung, stream_n, cfg.t_remove,
             cfg.total_ticks,
@@ -94,18 +130,22 @@ def canonical_fleet_shape_key(cfg: SimConfig) -> tuple:
             cfg.link_latency > 0)                               # latency
 
 
-def canonical_bucket_key(cfg: SimConfig, mode: str) -> tuple:
+def canonical_bucket_key(cfg: SimConfig, mode: str,
+                         peers: int = 1) -> tuple:
     """Equivalence-class key: requests with equal keys ride ONE
     compiled canonical program.  Falls back to the exact
     ``bucket_key`` when canonicalization does not apply — the caller
     can always tell which it got (canonical keys lead with
-    ``"canon"``)."""
+    ``"canon"``).  ``peers`` snaps the pad ladder to peer-shard-
+    divisible rungs (see :func:`canonical_fleet_shape_key`); the
+    service pins its full-strength peer count here so elastic
+    peer-shard shrink never moves a request's bucket key."""
     if mode not in MODES:
         raise ValueError(f"unknown mode {mode!r}; expected one of {MODES}")
     if not canonical_supported(cfg, mode):
         from .bucket import bucket_key
         return bucket_key(cfg, mode)
-    return ("canon", mode, canonical_fleet_shape_key(cfg),
+    return ("canon", mode, canonical_fleet_shape_key(cfg, peers=peers),
             quantized_plan_signature(cfg))
 
 

@@ -240,11 +240,12 @@ def replay(templates: list[Template], seeds_per_template: int,
     Raises on any per-request parity mismatch — a serving layer that
     changes results has no throughput to report.
 
-    ``mesh`` serves the stream from a lane mesh
-    (parallel/fleet_mesh.py): ``max_batch`` is then the PER-DEVICE
-    lane width, so pass ``max_batch = total_lanes // n_devices`` to
-    compare device counts at equal total lane width (the PERF §10
-    curve).
+    ``mesh`` serves the stream from a lane mesh — 1-D lanes or 2-D
+    lanes x peers (parallel/fleet_mesh.py): ``max_batch`` is then the
+    PER-LANE-DEVICE width, so pass ``max_batch = total_lanes //
+    n_lanes`` to compare decompositions at equal total lane width
+    (the PERF §10 curve); on a 2-D mesh the peer axis shards each
+    simulation's peer tables instead of multiplying capacity.
 
     The sequential baseline of one trace is the same however the
     service side is configured, so a caller comparing several service
@@ -297,6 +298,8 @@ def replay(templates: list[Template], seeds_per_template: int,
         "requests": len(trace),
         "distinct_templates": len(templates),
         "devices": stats["devices"],
+        "lanes": stats["lanes"],
+        "peers": stats["peers"],
         "capacity": stats["capacity"],
         "sequential_wall_s": round(seq_wall, 3),
         "service_wall_s": round(svc_wall, 3),
@@ -367,9 +370,16 @@ def chaos_replay(templates: list[Template], seeds_per_template: int,
     from .resilience import BreakerPolicy, RetryPolicy
     trace = build_trace(templates, seeds_per_template)
     n_dev = int(mesh.devices.size) if mesh is not None else 1
+    # capacity scales with the LANE axis only (2-D meshes spend the
+    # peer axis on n-sharding, not batch width)
+    if mesh is not None:
+        from ..parallel.fleet_mesh import mesh_axis_sizes
+        n_lanes = mesh_axis_sizes(mesh)[0]
+    else:
+        n_lanes = 1
     if device_loss_at == "mid":
         # roughly the middle fault-free dispatch of the stream
-        dispatches = max(1, len(trace) // max(1, max_batch * n_dev))
+        dispatches = max(1, len(trace) // max(1, max_batch * n_lanes))
         device_loss_at = max(2, dispatches // 2)
     injector = FaultInjector(seed=fault_seed, fault_rate=fault_rate,
                              device_loss_at=device_loss_at)
@@ -509,7 +519,15 @@ def elastic_replay(templates: list[Template], seeds_per_template: int,
     from .resilience import BreakerPolicy, RetryPolicy
     trace = build_trace(templates, seeds_per_template)
     n_dev = int(mesh.devices.size) if mesh is not None else 1
-    cap = max(1, max_batch * n_dev)
+    # dispatch capacity scales with the LANE axis only; the peer axis
+    # of a 2-D mesh shards n within each lane (and is what the
+    # axis-aware shrink drops first — the peer-shard elasticity path)
+    if mesh is not None:
+        from ..parallel.fleet_mesh import mesh_axis_sizes
+        n_lanes = mesh_axis_sizes(mesh)[0]
+    else:
+        n_lanes = 1
+    cap = max(1, max_batch * n_lanes)
     base_dispatches = max(1, -(-len(trace) // cap))
     if device_loss_at == "mid":
         # with legs the attempt stream is ~2-4x the batch count; the
@@ -627,6 +645,8 @@ def elastic_replay(templates: list[Template], seeds_per_template: int,
         "failures": stats["failures"],
         "devices_start": n_dev,
         "devices_end": stats["devices"],
+        "lanes_end": stats["lanes"],
+        "peers_end": stats["peers"],
         "sequential_wall_s": round(seq_wall, 3),
         "service_wall_s": round(svc_wall, 3),
         "speedup_vs_sequential": round(seq_wall / svc_wall, 2),

@@ -145,14 +145,20 @@ class FleetService:
     is enforced cooperatively (checked on every ``submit``/``pump``
     against ``clock()``), not by a background thread.
 
-    ``mesh`` (a 1-D lane mesh, ``parallel.fleet_mesh.make_lane_mesh``)
+    ``mesh`` (a 1-D lane mesh, ``parallel.fleet_mesh.make_lane_mesh``,
+    or a 2-D lanes x peers mesh, ``make_lane_peer_mesh`` — PR 19)
     serves every dispatch from the whole mesh: ``max_batch`` becomes
-    the PER-DEVICE lane width and the dispatch :attr:`capacity` is
-    ``max_batch x n_devices``; pad widths are rounded up to a
-    shard-divisible lane count (every pad policy, so a partial batch
-    always divides over the mesh), and the program cache keys gain the
-    mesh descriptor so a device-count change can never be served a
-    stale program.
+    the PER-LANE-DEVICE width and the dispatch :attr:`capacity` is
+    ``max_batch x n_lanes``; pad widths are rounded up to a
+    lane-divisible count (every pad policy, so a partial batch always
+    divides over the lane axis), and the program cache keys gain the
+    mesh descriptor — now carrying the 2-D shape — so a device-count
+    OR decomposition change can never be served a stale program.  On
+    a 2-D mesh each simulation's peer tables additionally shard over
+    the ``n_peers`` peer devices whenever ``cfg.n`` divides by the
+    peer count (peer-replicated otherwise), so one lane's n is no
+    longer bounded by one device's memory (docs/SERVING.md "2-D
+    capacity").
     """
 
     def __init__(self, max_batch: int = 8,
@@ -199,25 +205,54 @@ class FleetService:
                              "spellings of one budget; set at most one")
         if canonicalize and (checkpoint_every is not None
                              or checkpoint_every_s is not None):
-            raise ValueError(
+            from .canonical import CanonicalLegUnsupported
+            raise CanonicalLegUnsupported(
                 "canonicalize is incompatible with checkpointed "
                 "serving: legs validate resume cuts against the EXACT "
-                "segment plan, which canonical buckets quantize away")
-        if canonicalize and mesh is not None:
+                "segment plan, which canonical buckets quantize away "
+                "(docs/SERVING.md 'Bucket canonicalization')")
+        # validate the mesh shape EARLY — a typed constructor error,
+        # not a trace-time failure deep in shard_map — and learn the
+        # axis decomposition the service speaks everywhere below:
+        # batches spread over ``n_lanes``, each simulation's peer table
+        # shards (when divisible) over ``n_peers``
+        if mesh is not None:
+            from ..parallel.fleet_mesh import mesh_axis_sizes
+            n_lanes, n_peers, _ = mesh_axis_sizes(mesh)
+        else:
+            n_lanes, n_peers = 1, 1
+        if canonicalize and n_peers & (n_peers - 1):
             raise ValueError(
-                "canonicalize is single-device only: the mesh path "
-                "shards the real peer axis, which the pad-ladder "
-                "would re-shape per rung")
+                f"canonicalize over a mesh needs a power-of-two peer "
+                f"axis: the pad ladder doubles, so only pow2 "
+                f"peer-shard counts have peer-divisible rungs; got "
+                f"{n_peers} peers")
         self.max_batch = max_batch
         self.max_wait_s = max_wait_s
         self.pad_policy = pad_policy
         self.mesh = mesh
+        #: the CURRENT rung's axis decomposition (updated by
+        #: ``_degrade_mesh``/``_grow_mesh`` as the ladder moves):
+        #: ``n_lanes`` batch shards x ``n_peers`` peer-table shards
+        self.n_lanes = n_lanes
+        self.n_peers = n_peers
         self.n_devices = int(mesh.devices.size) if mesh is not None else 1
         #: the full-strength device tuple, captured at construction —
         #: the elasticity ladder's top rung: ``grow_mesh`` re-extends
         #: a degraded mesh back toward exactly these devices (PR 8)
         self._full_devices = tuple(mesh.devices.flat) \
             if mesh is not None else None
+        #: the full-strength 2-D shape + axis names (PR 19): the grow
+        #: ladder's target — lanes are restored first (checkpointed
+        #: lanes migrate back), then the peer axis doubles toward this
+        self._full_shape = tuple(mesh.devices.shape) \
+            if mesh is not None else None
+        self._full_axes = tuple(mesh.axis_names) \
+            if mesh is not None else None
+        #: canonical pad-ladder multiple: the FULL-STRENGTH peer count,
+        #: pinned at construction so elastic peer-shard shrink never
+        #: moves a request's canonical bucket key mid-stream
+        self._canon_peers = n_peers
         #: segment budget (ticks) above which a dispatch runs as
         #: RESUMABLE LEGS (PR 8 elastic serving): each leg ends at a
         #: PR-1 segment cut (models/segments.cut_for_budget), the
@@ -252,7 +287,8 @@ class FleetService:
         self.clock = clock
         self.cache = ProgramCache(block_size=block_size,
                                   chunk_ticks=chunk_ticks, mesh=mesh,
-                                  max_entries=cache_max_entries)
+                                  max_entries=cache_max_entries,
+                                  canon_rung_multiple=self._canon_peers)
         # failure plane: the (optional) deterministic fault injector
         # and the machinery that survives it (service/resilience.py)
         self.injector = injector
@@ -411,6 +447,7 @@ class FleetService:
                 "checkpoint_every": checkpoint_every,
                 "checkpoint_every_s": checkpoint_every_s,
                 "mesh_devices": self.n_devices,
+                "mesh_shape": [self.n_lanes, self.n_peers],
             })
 
     # ---- admission ---------------------------------------------------
@@ -549,9 +586,12 @@ class FleetService:
 
     @property
     def capacity(self) -> int:
-        """Lanes one dispatch can carry: ``max_batch`` per device,
-        times the lane mesh (1 without a mesh)."""
-        return self.max_batch * self.n_devices
+        """Lanes one dispatch can carry: ``max_batch`` per LANE
+        device, times the lane axis (1 without a mesh).  On a 2-D
+        mesh the peer axis does not multiply capacity — those devices
+        shard each simulation's peer tables instead (n-scaling, not
+        batch-scaling)."""
+        return self.max_batch * self.n_lanes
 
     # ---- flush policies ----------------------------------------------
     def pump(self) -> int:
@@ -791,7 +831,11 @@ class FleetService:
         otherwise."""
         if self.canonicalize:
             from .canonical import canonical_bucket_key
-            return canonical_bucket_key(cfg, mode)
+            # the FULL-STRENGTH peer count snaps the pad ladder to
+            # peer-shard-divisible rungs; pinned at construction so an
+            # elastic peer-shard shrink never moves a bucket key
+            return canonical_bucket_key(cfg, mode,
+                                        peers=self._canon_peers)
         return bucket_key(cfg, mode)
 
     @staticmethod
@@ -864,11 +908,13 @@ class FleetService:
     def _width(self, k: int) -> int:
         """Compiled lane width for a ``k``-request batch.
 
-        Every policy's width is rounded UP to a multiple of the mesh
-        size (a lane-sharded fleet needs ``B % n_devices == 0``;
-        without a mesh this is a no-op), and under a mesh the "full"
-        width is the whole-mesh :attr:`capacity` — one compiled width,
-        and so at most one build, per bucket either way.
+        Every policy's width is rounded UP to a multiple of the LANE
+        axis (a lane-sharded fleet needs ``B % n_lanes == 0``; without
+        a mesh this is a no-op — and the peer axis never constrains
+        the batch width, it shards within each lane), and under a mesh
+        the "full" width is the whole-mesh :attr:`capacity` — one
+        compiled width, and so at most one build, per bucket either
+        way.
         """
         if self.pad_policy == "none":
             w = k
@@ -879,7 +925,7 @@ class FleetService:
         # a mesh shrink mid-flight can leave an already-popped batch
         # wider than the NEW capacity; the width must still cover it
         w = max(w, k)
-        d = self.n_devices
+        d = self.n_lanes
         return -(-w // d) * d
 
     def _dispatch(self, key: tuple) -> None:
@@ -1530,14 +1576,20 @@ class FleetService:
             self._completed += 1
 
     def _degrade_mesh(self) -> None:
-        """One rung down the ladder: drop a device from the lane mesh
-        (to no mesh at all below two devices) and rebind the program
-        cache, so the bucket's next attempt rebuilds on the smaller
-        mesh through the existing mesh-keyed caches — sibling buckets
-        on other services keep their programs (eviction is per-handle
-        exact, core/fleet.py ``evict_programs``)."""
-        from ..parallel.fleet_mesh import shrink_mesh
+        """One rung down the ladder, axis-aware (PR 19): on a 2-D
+        mesh a device loss drops a PEER shard first — the peer axis
+        halves, every lane keeps serving, and each simulation's peer
+        tables re-shard across the survivors at the next dispatch
+        (checkpoints are mesh-independent host numpy, so nothing
+        restarts) — down to a 1-D lane mesh, then lane devices drop
+        one at a time (to no mesh at all below two devices).  Rebinds
+        the program cache so the bucket's next attempt rebuilds on the
+        smaller mesh through the existing mesh-keyed caches — sibling
+        buckets on other services keep their programs (eviction is
+        per-handle exact, core/fleet.py ``evict_programs``)."""
+        from ..parallel.fleet_mesh import mesh_axis_sizes, shrink_mesh
         self.mesh = shrink_mesh(self.mesh)
+        self.n_lanes, self.n_peers, _ = mesh_axis_sizes(self.mesh)
         self.n_devices = (int(self.mesh.devices.size)
                           if self.mesh is not None else 1)
         self.cache.rebind_mesh(self.mesh)
@@ -1552,14 +1604,25 @@ class FleetService:
         re-keys rather than evicts), so a shrink -> grow cycle costs
         zero rebuilds.  Queued and checkpointed lanes migrate onto the
         wider mesh at their next dispatch (the snapshots are
-        mesh-independent host numpy).  No-op on a service that never
-        had a mesh, or one already at full strength."""
-        from ..parallel.fleet_mesh import grow_mesh
-        new = grow_mesh(self.mesh, self._full_devices)
+        mesh-independent host numpy).  Axis-aware (PR 19): toward a
+        2-D full shape the ladder restores the LANE axis first, then
+        doubles the peer axis back toward full strength — the exact
+        inverse of ``_degrade_mesh``, and because every rung selects
+        the same flat device PREFIX, a grow-back lands on descriptors
+        the shrink already served (warm re-key, zero rebuilds).  No-op
+        on a service that never had a mesh, or one already at full
+        strength."""
+        from ..parallel.fleet_mesh import grow_mesh, mesh_axis_sizes
+        new = grow_mesh(self.mesh, self._full_devices,
+                        full_shape=self._full_shape,
+                        full_axes=self._full_axes)
         new_d = int(new.devices.size) if new is not None else 1
-        if new is self.mesh or new_d == self.n_devices:
+        if new is self.mesh or (new_d == self.n_devices
+                                and mesh_axis_sizes(new) ==
+                                mesh_axis_sizes(self.mesh)):
             return
         self.mesh = new
+        self.n_lanes, self.n_peers, _ = mesh_axis_sizes(new)
         self.n_devices = new_d
         self.cache.rebind_mesh(new)
         self._elastic["mesh_grows"] += 1
@@ -1826,6 +1889,11 @@ class FleetService:
             "max_batch": self.max_batch,
             "pad_policy": self.pad_policy,
             "devices": self.n_devices,
+            # the 2-D decomposition (PR 19): batch shards x peer-table
+            # shards at the CURRENT elasticity rung; devices ==
+            # lanes * peers whenever a mesh rides
+            "lanes": self.n_lanes,
+            "peers": self.n_peers,
             "capacity": self.capacity,
             # the failure domain (PR 5): lifetime-exact counters like
             # requests/dispatches above; the windowed per-dispatch

@@ -15,8 +15,10 @@ and the compile-surface columns (exact vs canonical bucket
 cardinality, fresh-build collapse, warm-lap hit rate, PR 16+),
 and the pipeline-depth columns (the best replay row's
 pipeline_depth plus the depth-sweep's measured open-loop saturation
-at depth 2, PR 17+) — so a regression (or a claimed win) is visible
-at a glance, PR over PR.
+at depth 2, PR 17+), and the 2-D mesh columns (the best lanes x peers
+serving row plus the peer-shrink elastic gate — restarted lanes and
+grow-back shape, PR 19+) — so a regression (or a claimed win) is
+visible at a glance, PR over PR.
 
     PYTHONPATH=. python scripts/bench_trajectory.py          # table
     PYTHONPATH=. python scripts/bench_trajectory.py --json   # rows
@@ -128,6 +130,20 @@ def load_rows():
         ds_rows = _get(load, "depth_sweep", "rows") or []
         ds_sat = {r.get("depth"): r.get("saturation_offered_rps")
                   for r in ds_rows if isinstance(r, dict)}
+        # 2-D lanes x peers serving entry (PR 19+): the sweep's best
+        # row by speedup plus the peer-shrink elastic gate; absent in
+        # earlier jsons -> every column renders "-"
+        m2d = sec.get("service_replay_mesh2d") or {}
+        m2d_best = None
+        for tag2, r2 in (m2d.get("sweep") or {}).items():
+            sp2 = r2.get("speedup_vs_sequential") \
+                if isinstance(r2, dict) else None
+            if sp2 is not None and (m2d_best is None
+                                    or sp2 > m2d_best[0]):
+                m2d_best = (sp2, tag2)
+        m2d_el = m2d.get("elastic_2x4") or {}
+        m2d_shape = (f"{m2d_el['lanes_end']}x{m2d_el['peers_end']}"
+                     if "lanes_end" in m2d_el else None)
         rows.append({
             "pr": pr,
             "backend": d.get("backend"),
@@ -156,6 +172,11 @@ def load_rows():
                 or None),
             "depth1_saturation_rps": ds_sat.get(1),
             "depth2_saturation_rps": ds_sat.get(2),
+            "mesh2d_best_speedup": m2d_best[0] if m2d_best else None,
+            "mesh2d_best_shape": m2d_best[1] if m2d_best else None,
+            "mesh2d_elastic_restarted":
+                m2d_el.get("restarted_from_zero"),
+            "mesh2d_elastic_shape_end": m2d_shape,
             "scenario_variants": scen.get("variants"),
             "scenario_families": scen.get("families"),
             "scenario_worlds": scen.get("worlds"),
@@ -211,6 +232,10 @@ def main(argv) -> int:
             ("sat rps", "load_saturation_rps", "{:.1f}"),
             ("depth", "replay_pipeline_depth", "{}"),
             ("d2 sat", "depth2_saturation_rps", "{:.1f}"),
+            ("LxP", "mesh2d_best_shape", "{}"),
+            ("LxP x", "mesh2d_best_speedup", "{:.2f}"),
+            ("p-shr", "mesh2d_elastic_restarted", "{}"),
+            ("p-end", "mesh2d_elastic_shape_end", "{}"),
             ("scen", "scenario_variants", "{}"),
             ("worlds", "scenario_worlds", "{}"),
             ("scen ok", "scenario_pass_rate", "{:.0%}"),

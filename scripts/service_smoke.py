@@ -9,6 +9,7 @@ Modes:
     python scripts/service_smoke.py quick             # small functional pass
     python scripts/service_smoke.py sweep             # max_batch sweep
     python scripts/service_smoke.py mesh [34]         # replay per device count
+    python scripts/service_smoke.py mesh2d [34]       # lanes x peers sweep
     python scripts/service_smoke.py chaos [34] [0.12] # seeded fault sweep
     python scripts/service_smoke.py pipeline [34]     # pipelined vs sync per D
     python scripts/service_smoke.py load [24]         # open-loop 3-seed sweep
@@ -93,6 +94,20 @@ lane width (max_batch = 8/D per device) — the PERF §10 serving curve;
 8 virtual CPU devices are forced before jax imports, mirroring
 tests/conftest.py.
 
+``mesh2d`` (PR 19) sweeps the lanes x peers FACTORIZATIONS of the
+same 8 devices — (1,1) solo, (8,1), (4,2), (2,4), (1,8) — with equal
+total lane width (max_batch = 8/lanes), over the acceptance stream
+plus a peer-SHARDABLE dense tier (n=16 divides both the 4- and 2-wide
+peer rungs; the grader's N=10 and the overlay family stay
+peer-replicated, so the mixed stream proves both routings serve side
+by side bit-identically).  One sequential baseline is shared across
+every row.  A peer-shrink elastic leg then serves the stream from the
+(2,4) mesh with one seeded device loss + return: the ladder drops a
+PEER shard first (lanes keep serving through (2,2)), grows back to
+(2,4), and the acceptance gates read zero restarted lanes, full
+grow-back, and the first fault seed replayed digest-for-digest —
+docs/SERVING.md "2-D capacity".
+
 ``chaos`` replays the same acceptance stream under SEEDED fault
 schedules (service/faults.py; docs/SERVING.md "Failure model"): for
 each fault seed it injects ~``fault_rate`` dispatch-boundary faults
@@ -124,8 +139,8 @@ import json
 import os
 import sys
 
-if sys.argv[1:2] and sys.argv[1] in ("mesh", "chaos", "pipeline",
-                                     "elastic", "recover"):
+if sys.argv[1:2] and sys.argv[1] in ("mesh", "mesh2d", "chaos",
+                                     "pipeline", "elastic", "recover"):
     # virtual devices must be forced before jax is first imported
     os.environ["JAX_PLATFORMS"] = "cpu"
     _flags = os.environ.get("XLA_FLAGS", "")
@@ -215,6 +230,88 @@ def main(argv) -> int:
                   f"device-wait frac {m['device_wait_frac']:.2f}",
                   flush=True)
         return 0
+    elif mode == "mesh2d":
+        from gossip_protocol_tpu.config import SimConfig
+        from gossip_protocol_tpu.parallel.fleet_mesh import (
+            make_lane_mesh, make_lane_peer_mesh)
+        from gossip_protocol_tpu.service import Template, elastic_replay
+        seeds = int(argv[1]) if len(argv) > 1 else 34
+        if jax.device_count() < 8:
+            print(f"mesh2d needs 8 (virtual) devices; only "
+                  f"{jax.device_count()} live", flush=True)
+            return 2
+        # the acceptance stream plus a peer-SHARDABLE dense tier (n=16
+        # divides both the 4- and 2-wide peer rungs; the grader's N=10
+        # and the overlay family stay peer-replicated, so the mix
+        # proves both routings serve side by side)
+        tpls = _templates(512, 96) + [
+            Template("dense16-drop", SimConfig(
+                max_nnb=16, single_failure=False, drop_msg=True,
+                msg_drop_prob=0.1, seed=0, total_ticks=60,
+                fail_tick=30, rejoin_after=15, drop_open_tick=10,
+                drop_close_tick=50))]
+        print(f"lanes x peers sweep: {seeds * len(tpls)} requests/row, "
+              "equal total lane width (max_batch = 8/lanes)", flush=True)
+        seq = None
+        rows = {}
+        for lanes, peers in ((1, 1), (8, 1), (4, 2), (2, 4), (1, 8)):
+            if peers > 1:
+                mesh = make_lane_peer_mesh(lanes, peers)
+            elif lanes > 1:
+                mesh = make_lane_mesh(lanes)
+            else:
+                mesh = None
+            kw = dict(max_batch=8 // lanes, mesh=mesh)
+            if seq is None:
+                m, seq = replay(tpls, seeds, return_legs=True, **kw)
+            else:
+                m = replay(tpls, seeds, sequential=seq, **kw)
+            rows[(lanes, peers)] = m
+            print(f"{lanes}x{peers}: "
+                  f"{m['speedup_vs_sequential']:5.2f}x sequential, "
+                  f"occupancy {m['mean_occupancy']:.2f}, "
+                  f"p95 {m['latency_p95_s']:.2f}s, device-wait frac "
+                  f"{m['device_wait_frac']:.2f}", flush=True)
+        # ---- the peer-shrink elastic leg -----------------------------
+        print("peer-shrink elastic leg ((2,4) -> (2,2) -> grown back):",
+              flush=True)
+        el_rows = []
+        for fseed in (7, 19):
+            e = elastic_replay(tpls, seeds_per_template=seeds,
+                               max_batch=4,
+                               mesh=make_lane_peer_mesh(2, 4),
+                               checkpoint_every=48, fault_seed=fseed,
+                               sequential=seq)
+            el_rows.append(e)
+            el = e["elastic"]
+            print(f"seed={fseed:3d}: loss@{e['device_loss_at']} "
+                  f"return@{e['device_return_at']}, completed "
+                  f"{e['completed']}/{e['requests']}, migrated "
+                  f"{el['lanes_migrated']}, grows {el['mesh_grows']}, "
+                  f"restarted {el['restarted_lanes']}, shape "
+                  f"{e['lanes_end']}x{e['peers_end']}, devices "
+                  f"{e['devices_start']}->{e['devices_end']}", flush=True)
+        e2 = elastic_replay(tpls, seeds_per_template=seeds, max_batch=4,
+                            mesh=make_lane_peer_mesh(2, 4),
+                            checkpoint_every=48, fault_seed=7,
+                            sequential=seq)
+        reproduced = (e2["schedule_digest"] == el_rows[0]["schedule_digest"]
+                      and e2["outcome_digest"] == el_rows[0]["outcome_digest"])
+        zero_restart = all(r["restarted_from_zero"] == 0 for r in el_rows)
+        grown = all((r["lanes_end"], r["peers_end"]) == (2, 4)
+                    for r in el_rows)
+        complete = all(r["completion_rate"] == 1.0 for r in el_rows)
+        ok = complete and zero_restart and grown and reproduced
+        print(f"acceptance: parity OK (enforced, every row), "
+              f"elastic completion=100% "
+              f"{'OK' if complete else 'FAIL'}, "
+              f"zero restarted-from-zero "
+              f"{'OK' if zero_restart else 'FAIL'}, grown back to 2x4 "
+              f"{'OK' if grown else 'FAIL'}, seed replay "
+              f"{'OK' if reproduced else 'FAIL'} "
+              f"(schedule {e2['schedule_digest']}, "
+              f"outcomes {e2['outcome_digest']})", flush=True)
+        return 0 if ok else 1
     elif mode == "pipeline":
         from gossip_protocol_tpu.parallel.fleet_mesh import make_lane_mesh
         seeds = int(argv[1]) if len(argv) > 1 else 34

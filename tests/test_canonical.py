@@ -322,11 +322,34 @@ def test_service_canonical_falls_back_to_exact_for_overlay():
     svc.drain()
 
 
-def test_service_canonicalize_rejects_checkpoint_and_mesh():
+def test_service_canonicalize_checkpoint_and_mesh_gates():
+    """The composition matrix (PR 19): canonicalize + checkpoint legs
+    stays a TYPED construction-time error (legs validate resume cuts
+    against the exact segment plan canonical buckets quantize away);
+    canonicalize + a non-power-of-two peer axis is rejected (the pad
+    ladder doubles, so only pow2 peer counts divide every rung); and
+    canonicalize + a pow2 2-D mesh is ACCEPTED and bit-identical."""
+    import jax
     from gossip_protocol_tpu.service import FleetService
+    from gossip_protocol_tpu.service.canonical import \
+        CanonicalLegUnsupported
     with pytest.raises(ValueError, match="checkpoint"):
         FleetService(canonicalize=True, checkpoint_every=16)
-    class _FakeMesh:
-        pass
-    with pytest.raises(ValueError, match="single-device"):
-        FleetService(canonicalize=True, mesh=_FakeMesh())
+    with pytest.raises(CanonicalLegUnsupported):
+        FleetService(canonicalize=True, checkpoint_every=16)
+    if jax.device_count() < 8:
+        pytest.skip("mesh legs need 8 (virtual) devices")
+    from gossip_protocol_tpu.parallel.fleet_mesh import \
+        make_lane_peer_mesh
+    with pytest.raises(ValueError, match="power-of-two peer axis"):
+        FleetService(canonicalize=True, mesh=make_lane_peer_mesh(2, 3))
+    svc = FleetService(max_batch=2, canonicalize=True,
+                       mesh=make_lane_peer_mesh(2, 4))
+    assert (svc.n_lanes, svc.n_peers) == (2, 4)
+    key = svc._bucket(_drop10(), "trace")
+    assert key[0] == "canon"
+    h = svc.submit(_drop10(seed=5), mode="trace")
+    svc.drain()
+    assert h.status == "completed"
+    _assert_lane_bitidentical(Simulation(_drop10(seed=5)).run(),
+                              h.result(), "canon over (2,4)")

@@ -462,6 +462,132 @@ def test_elastic_acceptance():
     assert m["restarted_from_zero"] == 0
 
 
+# ---- peer-axis elasticity (PR 19) ------------------------------------
+def test_shrink_grow_mesh_ladder_2d():
+    """The 2-D ladder and its inverse: shrink halves the PEER axis
+    first (lanes untouched) down to a 1-D lane mesh; grow restores
+    lanes first, then doubles peers back — every rung's descriptor
+    equal on the way down and on the way up (the warm-rekey
+    invariant: service/cache.py finds the retained programs)."""
+    import jax
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    from gossip_protocol_tpu.parallel.fleet_mesh import (
+        grow_mesh, make_lane_peer_mesh, mesh_axis_sizes,
+        mesh_descriptor, shrink_mesh)
+    m24 = make_lane_peer_mesh(2, 4)
+    assert mesh_axis_sizes(m24) == (2, 4, "peers")
+    full = tuple(m24.devices.flat)
+    down, m = [], m24
+    while m is not None:
+        down.append(mesh_descriptor(m))
+        m = shrink_mesh(m)
+    # (2,4) -> (2,2) -> 1-D (2,) -> None
+    assert [d[0] for d in down] == [("lanes", "peers"),
+                                    ("lanes", "peers"), ("lanes",)]
+    assert [d[2] for d in down] == [(2, 4), (2, 2), (2,)]
+    up, g = [], None
+    for _ in range(6):
+        g2 = grow_mesh(g, full, full_shape=(2, 4),
+                       full_axes=m24.axis_names)
+        if g2 is g:
+            break
+        g = g2
+        up.append(mesh_descriptor(g))
+    assert up == list(reversed(down))
+
+
+def test_peer_shard_loss_mid_sequence_zero_restarts():
+    """A device loss on the 2-D mesh drops a PEER shard — the lane
+    axis keeps serving, checkpointed lanes migrate across the
+    re-shard (host-numpy snapshots are mesh-independent), the device
+    return doubles the peer axis back — and nothing restarts from
+    tick 0, every result bit-identical to its solo run."""
+    import jax
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    from gossip_protocol_tpu.parallel.fleet_mesh import \
+        make_lane_peer_mesh
+    cfg = _dense_churn_drop()   # n=16: peer-sharded at 4 AND 2 peers
+    svc = FleetService(max_batch=2, mesh=make_lane_peer_mesh(2, 4),
+                       checkpoint_every=16,
+                       injector=FaultInjector(device_loss_at=2,
+                                              device_return_at=4),
+                       retry=_fast_retry(),
+                       breaker=BreakerPolicy(reset_after_s=float("inf")))
+    hs = [svc.submit(cfg, seed=s) for s in (1, 2, 3, 4)]
+    svc.drain()
+    assert all(h.status == "completed" for h in hs)
+    st = svc.stats()
+    assert st["failures"]["device_losses"] == 1
+    assert st["failures"]["device_returns"] == 1
+    assert st["elastic"]["mesh_grows"] == 1
+    assert st["elastic"]["restarted_lanes"] == 0
+    assert st["elastic"]["lanes_migrated"] >= 1
+    assert (st["lanes"], st["peers"]) == (2, 4)   # grown back whole
+    assert st["devices"] == 8 and svc.n_peers == 4
+    for s, h in zip((1, 2, 3, 4), hs):
+        _assert_dense_equal(solo_execute(cfg.replace(seed=s), "trace"),
+                            h.result(), tag=f"seed {s}")
+
+
+def test_mesh2d_shrink_grow_digest_equals_baseline():
+    """The PR-19 acceptance gate: digest replay of a (2,4) -> (2,2)
+    -> (2,4) peer-shard shrink/grow cycle equal to the UNINTERRUPTED
+    baseline, with zero restarted lanes."""
+    import jax
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    from gossip_protocol_tpu.parallel.fleet_mesh import \
+        make_lane_peer_mesh
+    from gossip_protocol_tpu.service.replay import result_digest
+    cfg = _dense_churn_drop()
+    seeds = (1, 2, 3, 4)
+
+    def run_once(injector):
+        svc = FleetService(max_batch=2,
+                           mesh=make_lane_peer_mesh(2, 4),
+                           checkpoint_every=16, injector=injector,
+                           retry=_fast_retry(),
+                           breaker=BreakerPolicy(
+                               reset_after_s=float("inf")))
+        hs = [svc.submit(cfg, seed=s) for s in seeds]
+        svc.drain()
+        assert all(h.done and not h.failed for h in hs)
+        return [result_digest(h.result()) for h in hs], svc.stats()
+
+    base, bst = run_once(None)
+    faulted, fst = run_once(FaultInjector(device_loss_at=2,
+                                          device_return_at=4))
+    assert faulted == base, "shrink/grow cycle changed results"
+    assert fst["elastic"]["restarted_lanes"] == 0
+    assert fst["elastic"]["mesh_grows"] >= 1
+    assert (fst["lanes"], fst["peers"]) == \
+        (bst["lanes"], bst["peers"]) == (2, 4)
+
+
+def test_elastic_replay_mesh2d_small():
+    """elastic_replay over the 2-D mesh: the in-line gates (100%
+    completion, zero restarts, lane migration, grow-back) plus the
+    2-D shape fields — the shrink drops the peer axis, the grow
+    restores the full (2,4) decomposition."""
+    import jax
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    from gossip_protocol_tpu.parallel.fleet_mesh import \
+        make_lane_peer_mesh
+    from gossip_protocol_tpu.service import Template, elastic_replay
+    tpls = [Template("churn-drop", _overlay_churn_drop()),
+            Template("dense-drop", _dense_churn_drop())]
+    m = elastic_replay(tpls, seeds_per_template=2, max_batch=2,
+                       mesh=make_lane_peer_mesh(2, 4),
+                       checkpoint_every=32, fault_seed=7)
+    assert m["completion_rate"] == 1.0
+    assert m["restarted_from_zero"] == 0
+    assert m["devices_end"] == m["devices_start"] == 8
+    assert (m["lanes_end"], m["peers_end"]) == (2, 4)
+
+
 # ---- wall-clock-triggered checkpoints (PR 9 satellite) ---------------
 class _StepClock:
     """A fake service clock that advances a fixed step per reading:
